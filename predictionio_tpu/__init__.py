@@ -1,0 +1,27 @@
+"""predictionio_tpu — a TPU-native ML serving & lifecycle framework.
+
+A ground-up re-design of the capability surface of PredictionIO
+(reference: Scala/Spark, ``/root/reference``) for TPU hardware:
+
+- DASE pipeline (DataSource -> Preparator -> Algorithm(s) -> Serving)
+  with typed params and a train/eval/deploy lifecycle
+  (cf. reference ``core/src/main/scala/io/prediction/controller/Engine.scala:80-86``).
+- Append-only event store with ``$set/$unset/$delete`` entity-property
+  aggregation (cf. ``data/.../storage/Event.scala``, ``LEventAggregator.scala``).
+- TPU compute path: JAX/XLA/Pallas kernels sharded over a
+  ``jax.sharding.Mesh`` replace Spark/MLlib jobs; XLA collectives over
+  ICI replace Spark shuffles.
+- Host-side data plane, REST servers (events/queries), CLI, evaluation
+  and hyperparameter tuning.
+
+Nothing here is a port: the architecture is JAX-first (functional
+transforms, SPMD over meshes, static shapes), the runtime is Python +
+C++ (ctypes) instead of JVM/akka, and persistence uses numpy/orbax
+instead of Kryo.
+"""
+
+__version__ = "0.1.0"
+
+from predictionio_tpu.data.event import Event, EventValidationError, validate_event
+from predictionio_tpu.data.datamap import DataMap, PropertyMap, EntityMap
+from predictionio_tpu.data.bimap import BiMap

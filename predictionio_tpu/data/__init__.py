@@ -1,0 +1,8 @@
+"""Data layer: event model, property bags, storage backends, stores.
+
+Capability parity with the reference ``data`` module
+(``/root/reference/data/src/main/scala/io/prediction/data/``), re-designed
+for a Python/JAX host runtime: DAOs are plain classes behind a registry,
+parallel reads return numpy column batches (the TPU ingest format) instead
+of Spark RDDs.
+"""

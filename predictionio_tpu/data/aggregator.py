@@ -1,0 +1,65 @@
+"""Fold ``$set/$unset/$delete`` events into current entity property state.
+
+Parity target: reference ``LEventAggregator.scala:39-132`` /
+``PEventAggregator.scala``. Semantics (dataMapAggregator, :91-112):
+
+- ``$set``    : merge event properties over current state (event wins)
+- ``$unset``  : remove the event's property keys from current state;
+                a ``$unset`` before any ``$set`` leaves state nonexistent
+- ``$delete`` : reset state to nonexistent
+- other events: ignored entirely (do not touch first/lastUpdated)
+
+Events are folded in ``event_time`` order; first/lastUpdated track the
+min/max event time over the special events seen.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
+from predictionio_tpu.data.event import Event
+
+AGGREGATOR_EVENT_NAMES = ("$set", "$unset", "$delete")
+
+
+def _fold(events: Iterable[Event]) -> Optional[PropertyMap]:
+    dm: Optional[DataMap] = None
+    first = None
+    last = None
+    for e in sorted(events, key=lambda ev: ev.event_time):
+        if e.event == "$set":
+            dm = e.properties if dm is None else dm.merged(e.properties)
+        elif e.event == "$unset":
+            dm = None if dm is None else dm.without(list(e.properties.keySet()))
+        elif e.event == "$delete":
+            dm = None
+        else:
+            continue  # non-special events do not affect aggregation
+        t = e.event_time
+        first = t if first is None or t < first else first
+        last = t if last is None or t > last else last
+    if dm is None:
+        return None
+    return PropertyMap(dm.fields, first_updated=first, last_updated=last)
+
+
+def aggregate_properties_single(events: Iterable[Event]) -> Optional[PropertyMap]:
+    """Aggregate one entity's events (LEventAggregator.scala:69-87)."""
+    return _fold(events)
+
+
+def aggregate_properties(events: Iterable[Event]) -> Dict[str, PropertyMap]:
+    """Group by entityId then fold; entities whose state resolved to
+
+    nonexistent (deleted / never set) are dropped (LEventAggregator.scala:39-57).
+    """
+    by_entity: Dict[str, list] = {}
+    for e in events:
+        by_entity.setdefault(e.entity_id, []).append(e)
+    out: Dict[str, PropertyMap] = {}
+    for eid, evs in by_entity.items():
+        pm = _fold(evs)
+        if pm is not None:
+            out[eid] = pm
+    return out

@@ -1,0 +1,100 @@
+"""Bidirectional ID mapping for dense matrix indexing.
+
+Parity target: reference ``data/.../storage/BiMap.scala:63-129`` — every ALS
+template uses ``BiMap.stringInt`` to map entity IDs onto matrix rows.
+
+TPU-native design: the forward map is a plain dict; the inverse is an
+O(1) numpy object array so that batched index->ID decoding of model output
+(top-k recommendation lists) is vectorized host-side.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Hashable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class BiMap:
+    """Immutable bidirectional map K <-> V (unique values required)."""
+
+    def __init__(self, forward: Dict[Hashable, Hashable]):
+        self._fwd = dict(forward)
+        self._inv: Optional[Dict[Hashable, Hashable]] = None
+        if len(set(self._fwd.values())) != len(self._fwd):
+            raise ValueError("BiMap values must be unique")
+
+    # -- constructors (BiMap.scala:63-129) --------------------------------
+    @classmethod
+    def string_int(cls, keys: Iterable[str]) -> "StringIndexBiMap":
+        """Map distinct keys to dense ints 0..n-1, insertion-ordered."""
+        return StringIndexBiMap(keys)
+
+    string_long = string_int  # Python ints are unbounded; same thing
+
+    # -- access ------------------------------------------------------------
+    def __getitem__(self, k: Hashable) -> Hashable:
+        return self._fwd[k]
+
+    def get(self, k: Hashable, default=None):
+        return self._fwd.get(k, default)
+
+    def __contains__(self, k: Hashable) -> bool:
+        return k in self._fwd
+
+    def __len__(self) -> int:
+        return len(self._fwd)
+
+    def __iter__(self) -> Iterator[Hashable]:
+        return iter(self._fwd)
+
+    def keys(self):
+        return self._fwd.keys()
+
+    def values(self):
+        return self._fwd.values()
+
+    def items(self):
+        return self._fwd.items()
+
+    def inverse(self) -> "BiMap":
+        return BiMap({v: k for k, v in self._fwd.items()})
+
+    def inv_get(self, v: Hashable, default=None):
+        if self._inv is None:
+            self._inv = {val: k for k, val in self._fwd.items()}
+        return self._inv.get(v, default)
+
+    def to_dict(self) -> Dict[Hashable, Hashable]:
+        return dict(self._fwd)
+
+
+class StringIndexBiMap(BiMap):
+    """String -> dense int index with vectorized inverse decoding."""
+
+    def __init__(self, keys: Iterable[str]):
+        ordered: List[str] = []
+        seen = set()
+        for k in keys:
+            if k not in seen:
+                seen.add(k)
+                ordered.append(k)
+        super().__init__({k: i for i, k in enumerate(ordered)})
+        self._labels = np.asarray(ordered, dtype=object)
+
+    @property
+    def labels(self) -> np.ndarray:
+        """Object ndarray such that labels[i] == key with index i."""
+        return self._labels
+
+    def decode(self, indices) -> np.ndarray:
+        """Vectorized index->key decoding (for top-k model outputs)."""
+        return self._labels[np.asarray(indices)]
+
+    def encode(self, keys: Sequence[str]) -> np.ndarray:
+        """Vectorized key->index encoding; raises KeyError on unknowns."""
+        try:
+            return np.fromiter((self._fwd[k] for k in keys), dtype=np.int32,
+                               count=len(keys))
+        except KeyError as e:
+            raise KeyError(f"unknown key {e.args[0]!r} in BiMap.encode") from e

@@ -1,0 +1,592 @@
+"""SQLite storage backend — the zero-service default.
+
+Capability parity with the reference's JDBC backend
+(``data/.../storage/jdbc/`` — the only reference backend implementing every
+DAO, SURVEY §2.2): events + all metadata + model blobs in one file DB.
+
+Schema notes: one ``events`` table partitioned by (app_id, channel_id)
+columns with a covering index on (app_id, channel_id, event_time) — the
+sqlite analog of the reference's HBase rowkey layout
+(``HBEventsUtil.scala:81-129``: hashed entity prefix ++ event time ++ uuid).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import sqlite3
+import threading
+from typing import Any, Dict, Iterable, List, Optional
+
+import dataclasses
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, new_event_id, validate_event
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    UNSET, AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS events (
+  event_id TEXT NOT NULL,
+  app_id INTEGER NOT NULL,
+  channel_id INTEGER NOT NULL DEFAULT -1,
+  event TEXT NOT NULL,
+  entity_type TEXT NOT NULL,
+  entity_id TEXT NOT NULL,
+  target_entity_type TEXT,
+  target_entity_id TEXT,
+  properties TEXT NOT NULL,
+  event_time REAL NOT NULL,
+  tags TEXT NOT NULL,
+  pr_id TEXT,
+  creation_time REAL NOT NULL,
+  PRIMARY KEY (app_id, channel_id, event_id)
+);
+CREATE INDEX IF NOT EXISTS idx_events_scan
+  ON events (app_id, channel_id, event_time);
+CREATE INDEX IF NOT EXISTS idx_events_entity
+  ON events (app_id, channel_id, entity_type, entity_id, event_time);
+CREATE TABLE IF NOT EXISTS apps (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL UNIQUE,
+  description TEXT
+);
+CREATE TABLE IF NOT EXISTS access_keys (
+  key TEXT PRIMARY KEY,
+  appid INTEGER NOT NULL,
+  events TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS channels (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL,
+  appid INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS engine_instances (
+  id TEXT PRIMARY KEY,
+  status TEXT NOT NULL,
+  start_time REAL NOT NULL,
+  end_time REAL NOT NULL,
+  engine_id TEXT NOT NULL,
+  engine_version TEXT NOT NULL,
+  engine_variant TEXT NOT NULL,
+  engine_factory TEXT NOT NULL,
+  batch TEXT NOT NULL DEFAULT '',
+  env TEXT NOT NULL DEFAULT '{}',
+  spark_conf TEXT NOT NULL DEFAULT '{}',
+  data_source_params TEXT NOT NULL DEFAULT '{}',
+  preparator_params TEXT NOT NULL DEFAULT '{}',
+  algorithms_params TEXT NOT NULL DEFAULT '[]',
+  serving_params TEXT NOT NULL DEFAULT '{}'
+);
+CREATE TABLE IF NOT EXISTS evaluation_instances (
+  id TEXT PRIMARY KEY,
+  status TEXT NOT NULL,
+  start_time REAL NOT NULL,
+  end_time REAL NOT NULL,
+  evaluation_class TEXT NOT NULL DEFAULT '',
+  engine_params_generator_class TEXT NOT NULL DEFAULT '',
+  batch TEXT NOT NULL DEFAULT '',
+  env TEXT NOT NULL DEFAULT '{}',
+  evaluator_results TEXT NOT NULL DEFAULT '',
+  evaluator_results_html TEXT NOT NULL DEFAULT '',
+  evaluator_results_json TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS models (
+  id TEXT PRIMARY KEY,
+  models BLOB NOT NULL
+);
+"""
+
+
+class SqliteClient:
+    """Shared connection manager; one client per DB path per process."""
+
+    _clients: Dict[str, "SqliteClient"] = {}
+    _clients_lock = threading.Lock()
+
+    def __init__(self, path: str):
+        self.path = path
+        self._local = threading.local()
+        self._init_lock = threading.Lock()
+        conn = self.conn()
+        with self._init_lock:
+            conn.executescript(_SCHEMA)
+            conn.commit()
+
+    @classmethod
+    def shared(cls, path: str) -> "SqliteClient":
+        with cls._clients_lock:
+            if path not in cls._clients:
+                cls._clients[path] = cls(path)
+            return cls._clients[path]
+
+    def conn(self) -> sqlite3.Connection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = sqlite3.connect(self.path, timeout=30.0)
+            c.execute("PRAGMA journal_mode=WAL")
+            c.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = c
+        return c
+
+    def close(self) -> None:
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            c.close()
+            self._local.conn = None
+
+
+def _ts(t: _dt.datetime) -> float:
+    return t.timestamp()
+
+
+def _from_ts(x: float) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(x, tz=_dt.timezone.utc)
+
+
+def _row_to_event(row) -> Event:
+    (event_id, event, entity_type, entity_id, tet, tei, props, etime, tags,
+     pr_id, ctime) = row
+    return Event(
+        event=event, entity_type=entity_type, entity_id=entity_id,
+        target_entity_type=tet, target_entity_id=tei,
+        properties=DataMap(json.loads(props)),
+        event_time=_from_ts(etime), tags=tuple(json.loads(tags)),
+        pr_id=pr_id, creation_time=_from_ts(ctime), event_id=event_id,
+    )
+
+
+_EVENT_COLS = ("event_id, event, entity_type, entity_id, target_entity_type, "
+               "target_entity_id, properties, event_time, tags, pr_id, "
+               "creation_time")
+
+
+class SqliteLEvents(base.LEvents):
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self._client = SqliteClient.shared(config.get("path", ":memory:"))
+
+    def _chan(self, channel_id) -> int:
+        return -1 if channel_id is None else int(channel_id)
+
+    def init(self, app_id, channel_id=None) -> bool:
+        return True  # single-table layout; nothing per-app to create
+
+    def remove(self, app_id, channel_id=None) -> bool:
+        c = self._client.conn()
+        c.execute("DELETE FROM events WHERE app_id=? AND channel_id=?",
+                  (int(app_id), self._chan(channel_id)))
+        c.commit()
+        return True
+
+    def close(self) -> None:
+        self._client.close()
+
+    def insert(self, event: Event, app_id, channel_id=None) -> str:
+        validate_event(event)
+        eid = event.event_id or new_event_id()
+        c = self._client.conn()
+        c.execute(
+            "INSERT OR REPLACE INTO events (event_id, app_id, channel_id, event,"
+            " entity_type, entity_id, target_entity_type, target_entity_id,"
+            " properties, event_time, tags, pr_id, creation_time)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (eid, int(app_id), self._chan(channel_id), event.event,
+             event.entity_type, event.entity_id, event.target_entity_type,
+             event.target_entity_id, event.properties.to_json(),
+             _ts(event.event_time), json.dumps(list(event.tags)),
+             event.pr_id, _ts(event.creation_time)),
+        )
+        c.commit()
+        return eid
+
+    def insert_batch(self, events: Iterable[Event], app_id,
+                     channel_id=None) -> List[str]:
+        """Bulk insert in one transaction (no reference analog; the TPU
+        ingest path needs it for import throughput)."""
+        c = self._client.conn()
+        ids: List[str] = []
+        rows = []
+        for event in events:
+            validate_event(event)
+            eid = event.event_id or new_event_id()
+            ids.append(eid)
+            rows.append(
+                (eid, int(app_id), self._chan(channel_id), event.event,
+                 event.entity_type, event.entity_id, event.target_entity_type,
+                 event.target_entity_id, event.properties.to_json(),
+                 _ts(event.event_time), json.dumps(list(event.tags)),
+                 event.pr_id, _ts(event.creation_time)))
+        c.executemany(
+            "INSERT OR REPLACE INTO events (event_id, app_id, channel_id, event,"
+            " entity_type, entity_id, target_entity_type, target_entity_id,"
+            " properties, event_time, tags, pr_id, creation_time)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows)
+        c.commit()
+        return ids
+
+    def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
+        c = self._client.conn()
+        row = c.execute(
+            f"SELECT {_EVENT_COLS} FROM events WHERE app_id=? AND channel_id=?"
+            " AND event_id=?",
+            (int(app_id), self._chan(channel_id), event_id)).fetchone()
+        return _row_to_event(row) if row else None
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        c = self._client.conn()
+        cur = c.execute(
+            "DELETE FROM events WHERE app_id=? AND channel_id=? AND event_id=?",
+            (int(app_id), self._chan(channel_id), event_id))
+        c.commit()
+        return cur.rowcount > 0
+
+    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
+             entity_type=None, entity_id=None, event_names=None,
+             target_entity_type=UNSET, target_entity_id=UNSET,
+             limit=None, reversed=False) -> Iterable[Event]:
+        where = ["app_id=?", "channel_id=?"]
+        args: List[Any] = [int(app_id), self._chan(channel_id)]
+        if start_time is not None:
+            where.append("event_time>=?")
+            args.append(_ts(start_time))
+        if until_time is not None:
+            where.append("event_time<?")
+            args.append(_ts(until_time))
+        if entity_type is not None:
+            where.append("entity_type=?")
+            args.append(entity_type)
+        if entity_id is not None:
+            where.append("entity_id=?")
+            args.append(entity_id)
+        if event_names is not None:
+            names = list(event_names)
+            where.append(f"event IN ({','.join('?' * len(names))})")
+            args.extend(names)
+        if target_entity_type is not UNSET:
+            if target_entity_type is None:
+                where.append("target_entity_type IS NULL")
+            else:
+                where.append("target_entity_type=?")
+                args.append(target_entity_type)
+        if target_entity_id is not UNSET:
+            if target_entity_id is None:
+                where.append("target_entity_id IS NULL")
+            else:
+                where.append("target_entity_id=?")
+                args.append(target_entity_id)
+        order = "DESC" if reversed else "ASC"
+        sql = (f"SELECT {_EVENT_COLS} FROM events WHERE {' AND '.join(where)} "
+               f"ORDER BY event_time {order}")
+        if limit is not None and limit >= 0:
+            sql += f" LIMIT {int(limit)}"
+        c = self._client.conn()
+        for row in c.execute(sql, args):
+            yield _row_to_event(row)
+
+
+class SqlitePEvents(base.LEventsBackedPEvents):
+    def __init__(self, config: Optional[dict] = None):
+        levents = SqliteLEvents(config)
+        super().__init__(levents)
+        self._levents = levents
+
+    def write(self, events, app_id, channel_id=None) -> None:
+        self._levents.insert_batch(events, app_id, channel_id)
+
+
+class SqliteApps(base.Apps):
+    def __init__(self, config: Optional[dict] = None):
+        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
+
+    def insert(self, app: App) -> Optional[int]:
+        c = self._c.conn()
+        try:
+            if app.id:
+                cur = c.execute(
+                    "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
+                    (app.id, app.name, app.description))
+            else:
+                cur = c.execute(
+                    "INSERT INTO apps (name, description) VALUES (?,?)",
+                    (app.name, app.description))
+            c.commit()
+            return cur.lastrowid if not app.id else app.id
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, app_id):
+        row = self._c.conn().execute(
+            "SELECT id, name, description FROM apps WHERE id=?",
+            (int(app_id),)).fetchone()
+        return App(*row) if row else None
+
+    def get_by_name(self, name):
+        row = self._c.conn().execute(
+            "SELECT id, name, description FROM apps WHERE name=?",
+            (name,)).fetchone()
+        return App(*row) if row else None
+
+    def get_all(self):
+        return [App(*r) for r in self._c.conn().execute(
+            "SELECT id, name, description FROM apps ORDER BY id")]
+
+    def update(self, app: App) -> bool:
+        c = self._c.conn()
+        cur = c.execute("UPDATE apps SET name=?, description=? WHERE id=?",
+                        (app.name, app.description, app.id))
+        c.commit()
+        return cur.rowcount > 0
+
+    def delete(self, app_id) -> bool:
+        c = self._c.conn()
+        cur = c.execute("DELETE FROM apps WHERE id=?", (int(app_id),))
+        c.commit()
+        return cur.rowcount > 0
+
+
+class SqliteAccessKeys(base.AccessKeys):
+    def __init__(self, config: Optional[dict] = None):
+        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
+
+    def insert(self, k: AccessKey) -> Optional[str]:
+        key = k.key or base.generate_access_key()
+        c = self._c.conn()
+        c.execute("INSERT OR REPLACE INTO access_keys (key, appid, events)"
+                  " VALUES (?,?,?)", (key, k.appid, json.dumps(list(k.events))))
+        c.commit()
+        return key
+
+    def get(self, key):
+        row = self._c.conn().execute(
+            "SELECT key, appid, events FROM access_keys WHERE key=?",
+            (key,)).fetchone()
+        return AccessKey(row[0], row[1], tuple(json.loads(row[2]))) if row else None
+
+    def get_all(self):
+        return [AccessKey(r[0], r[1], tuple(json.loads(r[2])))
+                for r in self._c.conn().execute(
+                    "SELECT key, appid, events FROM access_keys")]
+
+    def get_by_appid(self, appid):
+        return [AccessKey(r[0], r[1], tuple(json.loads(r[2])))
+                for r in self._c.conn().execute(
+                    "SELECT key, appid, events FROM access_keys WHERE appid=?",
+                    (int(appid),))]
+
+    def update(self, k: AccessKey) -> bool:
+        c = self._c.conn()
+        cur = c.execute("UPDATE access_keys SET appid=?, events=? WHERE key=?",
+                        (k.appid, json.dumps(list(k.events)), k.key))
+        c.commit()
+        return cur.rowcount > 0
+
+    def delete(self, key) -> bool:
+        c = self._c.conn()
+        cur = c.execute("DELETE FROM access_keys WHERE key=?", (key,))
+        c.commit()
+        return cur.rowcount > 0
+
+
+class SqliteChannels(base.Channels):
+    def __init__(self, config: Optional[dict] = None):
+        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
+
+    def insert(self, c: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(c.name):
+            return None
+        conn = self._c.conn()
+        cur = conn.execute("INSERT INTO channels (name, appid) VALUES (?,?)",
+                           (c.name, c.appid))
+        conn.commit()
+        return cur.lastrowid
+
+    def get(self, channel_id):
+        row = self._c.conn().execute(
+            "SELECT id, name, appid FROM channels WHERE id=?",
+            (int(channel_id),)).fetchone()
+        return Channel(*row) if row else None
+
+    def get_by_appid(self, appid):
+        return [Channel(*r) for r in self._c.conn().execute(
+            "SELECT id, name, appid FROM channels WHERE appid=?",
+            (int(appid),))]
+
+    def delete(self, channel_id) -> bool:
+        c = self._c.conn()
+        cur = c.execute("DELETE FROM channels WHERE id=?", (int(channel_id),))
+        c.commit()
+        return cur.rowcount > 0
+
+
+_EI_COLS = ("id, status, start_time, end_time, engine_id, engine_version,"
+            " engine_variant, engine_factory, batch, env, spark_conf,"
+            " data_source_params, preparator_params, algorithms_params,"
+            " serving_params")
+
+
+def _row_to_ei(r) -> EngineInstance:
+    return EngineInstance(
+        id=r[0], status=r[1], start_time=_from_ts(r[2]), end_time=_from_ts(r[3]),
+        engine_id=r[4], engine_version=r[5], engine_variant=r[6],
+        engine_factory=r[7], batch=r[8], env=json.loads(r[9]),
+        spark_conf=json.loads(r[10]), data_source_params=r[11],
+        preparator_params=r[12], algorithms_params=r[13], serving_params=r[14])
+
+
+class SqliteEngineInstances(base.EngineInstances):
+    def __init__(self, config: Optional[dict] = None):
+        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
+        self._lock = threading.Lock()
+
+    def insert(self, i: EngineInstance) -> str:
+        iid = i.id or new_ei_id()
+        i = dataclasses.replace(i, id=iid)
+        c = self._c.conn()
+        c.execute(
+            f"INSERT OR REPLACE INTO engine_instances ({_EI_COLS})"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (i.id, i.status, _ts(i.start_time), _ts(i.end_time), i.engine_id,
+             i.engine_version, i.engine_variant, i.engine_factory, i.batch,
+             json.dumps(i.env), json.dumps(i.spark_conf), i.data_source_params,
+             i.preparator_params, i.algorithms_params, i.serving_params))
+        c.commit()
+        return iid
+
+    def get(self, iid):
+        row = self._c.conn().execute(
+            f"SELECT {_EI_COLS} FROM engine_instances WHERE id=?",
+            (iid,)).fetchone()
+        return _row_to_ei(row) if row else None
+
+    def get_all(self):
+        return [_row_to_ei(r) for r in self._c.conn().execute(
+            f"SELECT {_EI_COLS} FROM engine_instances")]
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        return [_row_to_ei(r) for r in self._c.conn().execute(
+            f"SELECT {_EI_COLS} FROM engine_instances WHERE status='COMPLETED'"
+            " AND engine_id=? AND engine_version=? AND engine_variant=?"
+            " ORDER BY start_time DESC",
+            (engine_id, engine_version, engine_variant))]
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        rows = self.get_completed(engine_id, engine_version, engine_variant)
+        return rows[0] if rows else None
+
+    def update(self, i: EngineInstance) -> bool:
+        c = self._c.conn()
+        cur = c.execute(
+            "UPDATE engine_instances SET status=?, start_time=?, end_time=?,"
+            " engine_id=?, engine_version=?, engine_variant=?,"
+            " engine_factory=?, batch=?, env=?, spark_conf=?,"
+            " data_source_params=?, preparator_params=?, algorithms_params=?,"
+            " serving_params=? WHERE id=?",
+            (i.status, _ts(i.start_time), _ts(i.end_time), i.engine_id,
+             i.engine_version, i.engine_variant, i.engine_factory, i.batch,
+             json.dumps(i.env), json.dumps(i.spark_conf), i.data_source_params,
+             i.preparator_params, i.algorithms_params, i.serving_params, i.id))
+        c.commit()
+        return cur.rowcount > 0
+
+    def delete(self, iid) -> bool:
+        c = self._c.conn()
+        cur = c.execute("DELETE FROM engine_instances WHERE id=?", (iid,))
+        c.commit()
+        return cur.rowcount > 0
+
+
+_EVI_COLS = ("id, status, start_time, end_time, evaluation_class,"
+             " engine_params_generator_class, batch, env, evaluator_results,"
+             " evaluator_results_html, evaluator_results_json")
+
+
+def _row_to_evi(r) -> EvaluationInstance:
+    return EvaluationInstance(
+        id=r[0], status=r[1], start_time=_from_ts(r[2]), end_time=_from_ts(r[3]),
+        evaluation_class=r[4], engine_params_generator_class=r[5], batch=r[6],
+        env=json.loads(r[7]), evaluator_results=r[8],
+        evaluator_results_html=r[9], evaluator_results_json=r[10])
+
+
+class SqliteEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, config: Optional[dict] = None):
+        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
+
+    def insert(self, i: EvaluationInstance) -> str:
+        iid = i.id or new_ei_id("evi")
+        i = dataclasses.replace(i, id=iid)
+        c = self._c.conn()
+        c.execute(
+            f"INSERT OR REPLACE INTO evaluation_instances ({_EVI_COLS})"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+            (i.id, i.status, _ts(i.start_time), _ts(i.end_time),
+             i.evaluation_class, i.engine_params_generator_class, i.batch,
+             json.dumps(i.env), i.evaluator_results, i.evaluator_results_html,
+             i.evaluator_results_json))
+        c.commit()
+        return iid
+
+    def get(self, iid):
+        row = self._c.conn().execute(
+            f"SELECT {_EVI_COLS} FROM evaluation_instances WHERE id=?",
+            (iid,)).fetchone()
+        return _row_to_evi(row) if row else None
+
+    def get_all(self):
+        return [_row_to_evi(r) for r in self._c.conn().execute(
+            f"SELECT {_EVI_COLS} FROM evaluation_instances")]
+
+    def get_completed(self):
+        return [_row_to_evi(r) for r in self._c.conn().execute(
+            f"SELECT {_EVI_COLS} FROM evaluation_instances"
+            " WHERE status='EVALCOMPLETED' ORDER BY start_time DESC")]
+
+    def update(self, i: EvaluationInstance) -> bool:
+        c = self._c.conn()
+        cur = c.execute(
+            "UPDATE evaluation_instances SET status=?, start_time=?,"
+            " end_time=?, evaluation_class=?, engine_params_generator_class=?,"
+            " batch=?, env=?, evaluator_results=?, evaluator_results_html=?,"
+            " evaluator_results_json=? WHERE id=?",
+            (i.status, _ts(i.start_time), _ts(i.end_time), i.evaluation_class,
+             i.engine_params_generator_class, i.batch, json.dumps(i.env),
+             i.evaluator_results, i.evaluator_results_html,
+             i.evaluator_results_json, i.id))
+        c.commit()
+        return cur.rowcount > 0
+
+    def delete(self, iid) -> bool:
+        c = self._c.conn()
+        cur = c.execute("DELETE FROM evaluation_instances WHERE id=?", (iid,))
+        c.commit()
+        return cur.rowcount > 0
+
+
+class SqliteModels(base.Models):
+    def __init__(self, config: Optional[dict] = None):
+        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
+
+    def insert(self, m: Model) -> None:
+        c = self._c.conn()
+        c.execute("INSERT OR REPLACE INTO models (id, models) VALUES (?,?)",
+                  (m.id, m.models))
+        c.commit()
+
+    def get(self, mid):
+        row = self._c.conn().execute(
+            "SELECT id, models FROM models WHERE id=?", (mid,)).fetchone()
+        return Model(row[0], row[1]) if row else None
+
+    def delete(self, mid) -> bool:
+        c = self._c.conn()
+        cur = c.execute("DELETE FROM models WHERE id=?", (mid,))
+        c.commit()
+        return cur.rowcount > 0
+
+
+def new_ei_id(prefix: str = "ei") -> str:
+    import uuid
+    return f"{prefix}_{uuid.uuid4().hex[:16]}"

@@ -1,0 +1,126 @@
+"""Name-based, engine-facing event store facades.
+
+Parity targets: ``PEventStore`` (``data/.../store/PEventStore.scala:30-116``),
+``LEventStore`` (``store/LEventStore.scala:30-142``), and
+``Common.appNameToId`` (``store/Common.scala:28-49``) which resolves
+(appName, channelName) -> (appId, channelId) via the metadata repositories.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import UNSET
+
+
+def app_name_to_id(app_name: str,
+                   channel_name: Optional[str] = None) -> Tuple[int, Optional[int]]:
+    """(appName, channelName) -> (appId, channelId); raises on unknown names
+    (Common.scala:28-49)."""
+    apps = storage.get_metadata_apps()
+    app = apps.get_by_name(app_name)
+    if app is None:
+        raise ValueError(
+            f"App name {app_name} is not found. Have you created this app?")
+    channel_id: Optional[int] = None
+    if channel_name is not None:
+        channels = storage.get_metadata_channels().get_by_appid(app.id)
+        match = next((c for c in channels if c.name == channel_name), None)
+        if match is None:
+            raise ValueError(
+                f"Channel name {channel_name} is not found for app {app_name}.")
+        channel_id = match.id
+    return app.id, channel_id
+
+
+class PEventStore:
+    """Bulk reads for training (PEventStore.scala:54,94)."""
+
+    @staticmethod
+    def find(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+    ) -> List[Event]:
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        return storage.get_pevents().find(
+            app_id=app_id, channel_id=channel_id, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            entity_id=entity_id, event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id)
+
+    @staticmethod
+    def aggregate_properties(
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        return storage.get_pevents().aggregate_properties(
+            app_id=app_id, entity_type=entity_type, channel_id=channel_id,
+            start_time=start_time, until_time=until_time, required=required)
+
+
+class LEventStore:
+    """Low-latency reads at predict time (LEventStore.scala:58,114).
+
+    The reference exposes blocking calls with a timeout; our sqlite/memory
+    backends are local so calls are direct.
+    """
+
+    @staticmethod
+    def find_by_entity(
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        limit: Optional[int] = None,
+        latest: bool = True,
+    ) -> List[Event]:
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        return list(storage.get_levents().find(
+            app_id=app_id, channel_id=channel_id, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            entity_id=entity_id, event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id, limit=limit, reversed=latest))
+
+    @staticmethod
+    def find(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+    ) -> List[Event]:
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        return list(storage.get_levents().find(
+            app_id=app_id, channel_id=channel_id, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            entity_id=entity_id, event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id, limit=limit))

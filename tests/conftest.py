@@ -1,0 +1,46 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh before jax imports.
+
+Mirrors the reference's local-mode SparkContext substitution
+(``core/src/test/.../BaseTest.scala:15-33`` uses ``local[4]``): distributed
+code paths are exercised without real hardware, here via
+``xla_force_host_platform_device_count``.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from predictionio_tpu.data import storage  # noqa: E402
+from predictionio_tpu.data.storage import StorageConfig  # noqa: E402
+
+
+@pytest.fixture
+def mem_storage():
+    """Process-global registry backed by fresh in-memory DAOs."""
+    cfg = StorageConfig(
+        sources={"TEST": {"type": "memory"}},
+        repositories={"METADATA": "TEST", "EVENTDATA": "TEST",
+                      "MODELDATA": "TEST"},
+    )
+    storage.reset(cfg)
+    yield storage.registry()
+    storage.reset()
+
+
+@pytest.fixture
+def sqlite_storage(tmp_path):
+    cfg = StorageConfig(
+        sources={"TEST": {"type": "sqlite",
+                          "path": str(tmp_path / "pio_test.db")}},
+        repositories={"METADATA": "TEST", "EVENTDATA": "TEST",
+                      "MODELDATA": "TEST"},
+    )
+    storage.reset(cfg)
+    yield storage.registry()
+    storage.reset()

@@ -1,0 +1,98 @@
+"""$set/$unset/$delete fold semantics (parity: LEventAggregatorSpec)."""
+
+import datetime as dt
+
+from predictionio_tpu.data.aggregator import (
+    aggregate_properties, aggregate_properties_single,
+)
+from predictionio_tpu.data.event import Event
+
+UTC = dt.timezone.utc
+
+
+def t(i):
+    return dt.datetime(2020, 1, 1, 0, 0, i, tzinfo=UTC)
+
+
+def set_(eid, props, i):
+    return Event(event="$set", entity_type="user", entity_id=eid,
+                 properties=props, event_time=t(i))
+
+
+def unset(eid, props, i):
+    return Event(event="$unset", entity_type="user", entity_id=eid,
+                 properties=props, event_time=t(i))
+
+
+def delete(eid, i):
+    return Event(event="$delete", entity_type="user", entity_id=eid,
+                 event_time=t(i))
+
+
+class TestSingle:
+    def test_set_merges_latest_wins(self):
+        pm = aggregate_properties_single([
+            set_("u", {"a": 1, "b": 2}, 1),
+            set_("u", {"b": 3, "c": 4}, 2),
+        ])
+        assert pm.fields == {"a": 1, "b": 3, "c": 4}
+        assert pm.first_updated == t(1)
+        assert pm.last_updated == t(2)
+
+    def test_order_independent_of_input_order(self):
+        pm = aggregate_properties_single([
+            set_("u", {"b": 3}, 2),
+            set_("u", {"a": 1, "b": 2}, 1),
+        ])
+        assert pm.fields == {"a": 1, "b": 3}
+
+    def test_unset_removes_keys(self):
+        pm = aggregate_properties_single([
+            set_("u", {"a": 1, "b": 2}, 1),
+            unset("u", {"a": 0}, 2),
+        ])
+        assert pm.fields == {"b": 2}
+
+    def test_unset_before_set_is_noop_state(self):
+        pm = aggregate_properties_single([unset("u", {"a": 0}, 1)])
+        assert pm is None
+
+    def test_delete_resets(self):
+        pm = aggregate_properties_single([
+            set_("u", {"a": 1}, 1),
+            delete("u", 2),
+        ])
+        assert pm is None
+
+    def test_set_after_delete(self):
+        pm = aggregate_properties_single([
+            set_("u", {"a": 1}, 1),
+            delete("u", 2),
+            set_("u", {"b": 9}, 3),
+        ])
+        assert pm.fields == {"b": 9}
+        assert pm.first_updated == t(1)  # tracks all special events
+        assert pm.last_updated == t(3)
+
+    def test_other_events_ignored(self):
+        pm = aggregate_properties_single([
+            set_("u", {"a": 1}, 1),
+            Event(event="rate", entity_type="user", entity_id="u",
+                  properties={"a": 99}, event_time=t(5)),
+        ])
+        assert pm.fields == {"a": 1}
+        assert pm.last_updated == t(1)  # non-special event did not touch times
+
+    def test_empty(self):
+        assert aggregate_properties_single([]) is None
+
+
+class TestGrouped:
+    def test_groups_and_drops_deleted(self):
+        out = aggregate_properties([
+            set_("u1", {"a": 1}, 1),
+            set_("u2", {"a": 2}, 1),
+            delete("u2", 2),
+        ])
+        assert set(out) == {"u1"}
+        assert out["u1"].fields == {"a": 1}

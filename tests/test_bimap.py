@@ -1,0 +1,40 @@
+"""BiMap behavior (parity: BiMapSpec)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap
+
+
+class TestBiMap:
+    def test_string_int_dense(self):
+        bm = BiMap.string_int(["a", "b", "c", "b"])
+        assert bm["a"] == 0 and bm["b"] == 1 and bm["c"] == 2
+        assert len(bm) == 3
+
+    def test_inverse(self):
+        bm = BiMap.string_int(["x", "y"])
+        inv = bm.inverse()
+        assert inv[0] == "x" and inv[1] == "y"
+        assert bm.inv_get(1) == "y"
+        assert bm.inv_get(99, "dflt") == "dflt"
+
+    def test_unique_values_required(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 1, "b": 1})
+
+    def test_vectorized_encode_decode(self):
+        bm = BiMap.string_int(["i1", "i2", "i3"])
+        idx = bm.encode(["i3", "i1"])
+        assert idx.dtype == np.int32
+        assert idx.tolist() == [2, 0]
+        assert bm.decode([0, 2]).tolist() == ["i1", "i3"]
+        with pytest.raises(KeyError):
+            bm.encode(["nope"])
+
+    def test_dict_protocol(self):
+        bm = BiMap.string_int(["a"])
+        assert "a" in bm
+        assert bm.get("a") == 0
+        assert bm.get("z") is None
+        assert list(bm.keys()) == ["a"]

@@ -1,0 +1,114 @@
+"""Event model + validation rules (parity: EventValidation, Event.scala:109-177)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.event import (
+    Event, EventValidationError, validate_event, is_reserved_prefix,
+)
+
+
+def ev(**kw):
+    base = dict(event="rate", entity_type="user", entity_id="u1")
+    base.update(kw)
+    return Event(**base)
+
+
+class TestValidation:
+    def test_valid_plain_event(self):
+        validate_event(ev())
+
+    def test_valid_target_event(self):
+        validate_event(ev(target_entity_type="item", target_entity_id="i1"))
+
+    def test_valid_set(self):
+        validate_event(ev(event="$set", properties={"a": 1}))
+
+    def test_empty_event_name(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event=""))
+
+    def test_empty_entity_type(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(entity_type=""))
+
+    def test_empty_entity_id(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(entity_id=""))
+
+    def test_target_fields_must_come_together(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(target_entity_type="item"))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(target_entity_id="i1"))
+
+    def test_empty_target_strings(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(target_entity_type="", target_entity_id="i1"))
+
+    def test_unset_requires_properties(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event="$unset"))
+        validate_event(ev(event="$unset", properties={"a": 1}))
+
+    def test_reserved_prefix_event_names(self):
+        for name in ("$foo", "pio_foo"):
+            with pytest.raises(EventValidationError):
+                validate_event(ev(event=name))
+        validate_event(ev(event="$delete"))
+
+    def test_special_event_cannot_have_target(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event="$set", properties={"a": 1},
+                              target_entity_type="item",
+                              target_entity_id="i1"))
+
+    def test_reserved_entity_type(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(entity_type="pio_user"))
+        validate_event(ev(entity_type="pio_pr"))  # built-in
+
+    def test_reserved_target_entity_type(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(target_entity_type="pio_x",
+                              target_entity_id="1"))
+
+    def test_reserved_property_names(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(properties={"pio_score": 1}))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(properties={"$score": 1}))
+
+    def test_is_reserved_prefix(self):
+        assert is_reserved_prefix("$x")
+        assert is_reserved_prefix("pio_x")
+        assert not is_reserved_prefix("x")
+
+
+class TestWireFormat:
+    def test_roundtrip(self):
+        e = ev(target_entity_type="item", target_entity_id="i7",
+               properties={"rating": 4.5}, tags=("a", "b"), pr_id="pk1")
+        e2 = Event.from_json(e.to_json())
+        assert e2.event == e.event
+        assert e2.entity_id == e.entity_id
+        assert e2.target_entity_id == "i7"
+        assert e2.properties.get("rating", float) == 4.5
+        assert e2.tags == ("a", "b")
+        assert e2.pr_id == "pk1"
+        assert e2.event_time == e.event_time
+
+    def test_from_dict_requires_core_fields(self):
+        with pytest.raises(EventValidationError):
+            Event.from_dict({"event": "rate"})
+
+    def test_millis_timestamp_accepted(self):
+        e = Event.from_dict({"event": "rate", "entityType": "user",
+                             "entityId": "u1", "eventTime": 1000.0})
+        assert e.event_time == dt.datetime(1970, 1, 1, 0, 0, 1,
+                                           tzinfo=dt.timezone.utc)
+
+    def test_naive_times_become_utc(self):
+        e = ev(event_time=dt.datetime(2020, 1, 1))
+        assert e.event_time.tzinfo is not None
