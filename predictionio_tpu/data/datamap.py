@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
+import typing as _t
 from typing import Any, Dict, Iterator, Mapping, Optional, Sequence
 
 
@@ -88,8 +89,32 @@ class DataMap(Mapping[str, Any]):
         if name not in self._fields:
             raise DataMapError(f"The field {name} is required.")
 
-    def get(self, name: str, typ: Optional[type] = None, default: Any = ...) -> Any:
-        """Typed get; raises DataMapError when missing unless a default is given."""
+    _NO_TYP = object()
+
+    def get(self, name: str, typ: Any = _NO_TYP, default: Any = ...) -> Any:
+        """Typed get; raises DataMapError when missing unless a default is given.
+
+        Also honors ``Mapping.get`` semantics: a non-type second positional
+        argument (including None) is treated as the default — ``dm.get('k', 0)``
+        returns 0 when 'k' is absent, like any Mapping.
+        """
+        if typ is DataMap._NO_TYP:
+            typ = None
+        elif not isinstance(typ, type) and typ is not None:
+            # typing generics (Optional[int], List[str], ...) look like
+            # defaults to isinstance — reject loudly instead of silently
+            # disabling validation.
+            if (getattr(typ, "__module__", None) == "typing"
+                    or _t.get_origin(typ) is not None):
+                raise TypeError(
+                    f"get() does not support typing generics, got {typ!r}; "
+                    f"use a concrete type (int, float, str, list, ...)")
+            if default is not ...:
+                raise TypeError(f"get() type argument must be a type, "
+                                f"got {typ!r}")
+            typ, default = None, typ
+        elif typ is None and default is ...:
+            default = None  # Mapping.get(key, None)
         if name not in self._fields or self._fields[name] is None:
             if default is not ...:
                 return default

@@ -100,9 +100,21 @@ class StorageConfig:
         if not sources:
             sources["DEFAULT"] = {"type": "sqlite",
                                   "path": default_storage_path()}
-        default_source = next(iter(sources))
-        for repo in REPOSITORIES:
-            repositories.setdefault(repo, default_source)
+        unbound = [r for r in REPOSITORIES if r not in repositories]
+        if unbound:
+            if len(sources) == 1:
+                only = next(iter(sources))
+                for repo in unbound:
+                    repositories[repo] = only
+            else:
+                # Never guess among multiple sources — data could silently
+                # land in the wrong backend (cf. Storage.scala:144-193,
+                # which requires explicit repository bindings).
+                raise StorageError(
+                    f"Repositories {unbound} have no "
+                    f"PIO_STORAGE_REPOSITORIES_<REPO>_SOURCE set and more "
+                    f"than one source is defined ({sorted(sources)}); bind "
+                    f"them explicitly.")
         for repo, src in repositories.items():
             if src not in sources:
                 raise StorageError(
@@ -131,9 +143,20 @@ class StorageRegistry:
         return self._config
 
     def reset(self, config: Optional[StorageConfig] = None) -> None:
+        """Swap config and tear down DAOs this registry created.
+
+        Teardown is backend-agnostic: any cached DAO exposing ``shutdown()``
+        (e.g. the sqlite DAOs' client teardown) is shut down; DAOs created
+        outside this registry are untouched.
+        """
         with self._lock:
+            old = list(self._cache.values())
             self._config = config
             self._cache = {}
+            for dao in old:
+                shutdown = getattr(dao, "shutdown", None)
+                if callable(shutdown):
+                    shutdown()
 
     def _dao(self, repo: str, kind: str):
         source = self.config.repositories[repo]
@@ -193,7 +216,10 @@ class StorageRegistry:
         eid = levents.insert(
             Event(event="$set", entity_type="status_check", entity_id="check",
                   properties={"ok": True}), 0)
-        assert levents.get(eid, 0) is not None
+        if levents.get(eid, 0) is None:
+            raise StorageError(
+                "Event store round-trip failed: inserted test event "
+                "could not be read back")
         levents.delete(eid, 0)
         levents.remove(0)
 

@@ -56,6 +56,13 @@ class LEvents(abc.ABC):
                channel_id: Optional[int] = None) -> str:
         """Insert; returns the assigned event ID (futureInsert parity)."""
 
+    def insert_batch(self, events: Iterable[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> List[str]:
+        """Bulk insert; returns assigned IDs in order. Backends override with
+        a transactional fast path (the TPU ingest path needs the throughput;
+        no single reference analog — closest is PEvents.write)."""
+        return [self.insert(e, app_id, channel_id) for e in events]
+
     @abc.abstractmethod
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]: ...
@@ -189,8 +196,7 @@ class LEventsBackedPEvents(PEvents):
             target_entity_id=target_entity_id))
 
     def write(self, events, app_id, channel_id=None) -> None:
-        for e in events:
-            self._l.insert(e, app_id, channel_id)
+        self._l.insert_batch(events, app_id, channel_id)
 
     def delete(self, event_ids, app_id, channel_id=None) -> None:
         for eid in event_ids:

@@ -119,9 +119,14 @@ class MemApps(base.Apps):
         with self._t.lock:
             if any(a.name == app.name for a in self._t.rows.values()):
                 return None
-            aid = app.id if app.id else next(self._t.next_id)
-            while aid in self._t.rows:
+            if app.id:
+                if app.id in self._t.rows:
+                    return None  # explicit id conflict (matches sqlite)
+                aid = app.id
+            else:
                 aid = next(self._t.next_id)
+                while aid in self._t.rows:
+                    aid = next(self._t.next_id)
             self._t.rows[aid] = App(aid, app.name, app.description)
             return aid
 
@@ -186,9 +191,14 @@ class MemChannels(base.Channels):
         if not Channel.is_valid_name(c.name):
             return None
         with self._t.lock:
-            cid = c.id if c.id else next(self._t.next_id)
-            while cid in self._t.rows:
+            if c.id:
+                if c.id in self._t.rows:
+                    return None  # explicit id conflict (matches sqlite)
+                cid = c.id
+            else:
                 cid = next(self._t.next_id)
+                while cid in self._t.rows:
+                    cid = next(self._t.next_id)
             self._t.rows[cid] = Channel(cid, c.name, c.appid)
             return cid
 

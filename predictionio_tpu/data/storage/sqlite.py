@@ -12,11 +12,13 @@ sqlite analog of the reference's HBase rowkey layout
 
 from __future__ import annotations
 
+import contextlib
 import datetime as _dt
 import json
 import sqlite3
 import threading
-from typing import Any, Dict, Iterable, List, Optional
+import weakref
+from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import dataclasses
 
@@ -101,41 +103,158 @@ CREATE TABLE IF NOT EXISTS models (
 
 
 class SqliteClient:
-    """Shared connection manager; one client per DB path per process."""
+    """Shared connection manager; one client per DB path per process.
+
+    File-backed paths get thread-local connections (WAL mode; sqlite file
+    locking isolates their transactions). ``:memory:`` uses ONE connection
+    shared by all threads (check_same_thread=False; sqlite's serialized mode
+    makes that safe) — per-thread connections would each see a separate empty
+    database. Because a shared connection also shares one transaction, every
+    write goes through :meth:`tx`, which serializes execute+commit under a
+    client lock. DAO-level ``close()`` is a no-op — ``shutdown()`` (or
+    ``shutdown_all()``) tears down every connection and evicts the client.
+    """
 
     _clients: Dict[str, "SqliteClient"] = {}
     _clients_lock = threading.Lock()
 
     def __init__(self, path: str):
         self.path = path
+        self._in_memory = path == ":memory:"
         self._local = threading.local()
-        self._init_lock = threading.Lock()
+        # Per-thread connections keyed by thread ident with a weakref to the
+        # owning Thread: a dying thread must not pin its connection open, so
+        # conn() prunes-and-closes entries whose thread is gone.
+        self._thread_conns: Dict[int, tuple] = {}
+        self._conns_lock = threading.Lock()
+        self._tx_lock = threading.RLock()
+        self._closed = False
+        self._refs = 0
+        self._shared_conn: Optional[sqlite3.Connection] = None
+        if self._in_memory:
+            self._shared_conn = sqlite3.connect(
+                ":memory:", timeout=30.0, check_same_thread=False)
         conn = self.conn()
-        with self._init_lock:
-            conn.executescript(_SCHEMA)
-            conn.commit()
+        conn.executescript(_SCHEMA)
+        conn.commit()
 
     @classmethod
     def shared(cls, path: str) -> "SqliteClient":
+        """Obtain the client for ``path``, taking one reference. Each caller
+        (one per DAO) must balance with ``release()``; the client tears down
+        only when the last reference is gone."""
         with cls._clients_lock:
-            if path not in cls._clients:
-                cls._clients[path] = cls(path)
-            return cls._clients[path]
+            client = cls._clients.get(path)
+            if client is None or client._closed:
+                client = cls(path)
+                cls._clients[path] = client
+            client._refs += 1
+            return client
+
+    @classmethod
+    def shutdown_all(cls) -> None:
+        """Force-teardown every client regardless of refcounts (tests)."""
+        with cls._clients_lock:
+            clients = list(cls._clients.values())
+            cls._clients.clear()
+        for c in clients:
+            c._teardown()
+
+    def release(self) -> None:
+        """Drop one DAO's reference; teardown when the last one is released."""
+        with SqliteClient._clients_lock:
+            self._refs -= 1
+            if self._refs > 0:
+                return
+            if SqliteClient._clients.get(self.path) is self:
+                del SqliteClient._clients[self.path]
+        self._teardown()
 
     def conn(self) -> sqlite3.Connection:
+        if self._closed:
+            raise base.StorageError(f"SqliteClient({self.path}) is shut down")
+        if self._shared_conn is not None:
+            return self._shared_conn
         c = getattr(self._local, "conn", None)
         if c is None:
-            c = sqlite3.connect(self.path, timeout=30.0)
+            c = sqlite3.connect(self.path, timeout=30.0,
+                                check_same_thread=False)
             c.execute("PRAGMA journal_mode=WAL")
             c.execute("PRAGMA synchronous=NORMAL")
             self._local.conn = c
+            thread = threading.current_thread()
+            with self._conns_lock:
+                self._prune_dead_locked()
+                self._thread_conns[thread.ident] = (weakref.ref(thread), c)
         return c
 
+    def _prune_dead_locked(self) -> None:
+        def gone(tref):
+            t = tref()
+            return t is None or not t.is_alive()
+
+        dead = [ident for ident, (tref, _) in self._thread_conns.items()
+                if gone(tref)]
+        for ident in dead:
+            _, conn = self._thread_conns.pop(ident)
+            try:
+                conn.close()
+            except sqlite3.Error:  # pragma: no cover - best-effort cleanup
+                pass
+
+    @contextlib.contextmanager
+    def tx(self):
+        """One atomic write transaction: execute under the client lock,
+        commit on success, roll back on error."""
+        with self._tx_lock:
+            conn = self.conn()
+            try:
+                yield conn
+                conn.commit()
+            except BaseException:
+                conn.rollback()
+                raise
+
+    def query(self, sql: str, args: Sequence[Any] = ()) -> List[tuple]:
+        """Read query returning all rows. On the shared :memory: connection
+        this holds the tx lock so readers never observe another thread's
+        uncommitted writes (file-backed threads have their own connections
+        and WAL snapshot isolation instead)."""
+        if self._shared_conn is not None:
+            with self._tx_lock:
+                return self._shared_conn.execute(sql, tuple(args)).fetchall()
+        return self.conn().execute(sql, tuple(args)).fetchall()
+
+    def query_one(self, sql: str, args: Sequence[Any] = ()) -> Optional[tuple]:
+        rows = self.query(sql, args)
+        return rows[0] if rows else None
+
+    def shutdown(self) -> None:
+        """Close every connection and evict this client from the cache."""
+        with SqliteClient._clients_lock:
+            if SqliteClient._clients.get(self.path) is self:
+                del SqliteClient._clients[self.path]
+        self._teardown()
+
+    def _teardown(self) -> None:
+        self._closed = True
+        with self._conns_lock:
+            conns = [c for _, c in self._thread_conns.values()]
+            self._thread_conns.clear()
+        if self._shared_conn is not None:
+            conns.append(self._shared_conn)
+            self._shared_conn = None
+        for c in conns:
+            try:
+                c.close()
+            except sqlite3.Error:  # pragma: no cover - best-effort cleanup
+                pass
+
     def close(self) -> None:
-        c = getattr(self._local, "conn", None)
-        if c is not None:
-            c.close()
-            self._local.conn = None
+        """DAO-level close: a no-op (other DAOs share this client).
+
+        Use :meth:`shutdown` for an explicit client-level teardown.
+        """
 
 
 def _ts(t: _dt.datetime) -> float:
@@ -175,38 +294,38 @@ class SqliteLEvents(base.LEvents):
         return True  # single-table layout; nothing per-app to create
 
     def remove(self, app_id, channel_id=None) -> bool:
-        c = self._client.conn()
-        c.execute("DELETE FROM events WHERE app_id=? AND channel_id=?",
-                  (int(app_id), self._chan(channel_id)))
-        c.commit()
+        with self._client.tx() as c:
+            c.execute("DELETE FROM events WHERE app_id=? AND channel_id=?",
+                      (int(app_id), self._chan(channel_id)))
         return True
 
     def close(self) -> None:
         self._client.close()
 
+    def shutdown(self) -> None:
+        self._client.release()
+
     def insert(self, event: Event, app_id, channel_id=None) -> str:
         validate_event(event)
         eid = event.event_id or new_event_id()
-        c = self._client.conn()
-        c.execute(
-            "INSERT OR REPLACE INTO events (event_id, app_id, channel_id, event,"
-            " entity_type, entity_id, target_entity_type, target_entity_id,"
-            " properties, event_time, tags, pr_id, creation_time)"
-            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-            (eid, int(app_id), self._chan(channel_id), event.event,
-             event.entity_type, event.entity_id, event.target_entity_type,
-             event.target_entity_id, event.properties.to_json(),
-             _ts(event.event_time), json.dumps(list(event.tags)),
-             event.pr_id, _ts(event.creation_time)),
-        )
-        c.commit()
+        with self._client.tx() as c:
+            c.execute(
+                "INSERT OR REPLACE INTO events (event_id, app_id, channel_id,"
+                " event, entity_type, entity_id, target_entity_type,"
+                " target_entity_id, properties, event_time, tags, pr_id,"
+                " creation_time) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (eid, int(app_id), self._chan(channel_id), event.event,
+                 event.entity_type, event.entity_id, event.target_entity_type,
+                 event.target_entity_id, event.properties.to_json(),
+                 _ts(event.event_time), json.dumps(list(event.tags)),
+                 event.pr_id, _ts(event.creation_time)),
+            )
         return eid
 
     def insert_batch(self, events: Iterable[Event], app_id,
                      channel_id=None) -> List[str]:
         """Bulk insert in one transaction (no reference analog; the TPU
         ingest path needs it for import throughput)."""
-        c = self._client.conn()
         ids: List[str] = []
         rows = []
         for event in events:
@@ -219,29 +338,28 @@ class SqliteLEvents(base.LEvents):
                  event.target_entity_id, event.properties.to_json(),
                  _ts(event.event_time), json.dumps(list(event.tags)),
                  event.pr_id, _ts(event.creation_time)))
-        c.executemany(
-            "INSERT OR REPLACE INTO events (event_id, app_id, channel_id, event,"
-            " entity_type, entity_id, target_entity_type, target_entity_id,"
-            " properties, event_time, tags, pr_id, creation_time)"
-            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows)
-        c.commit()
+        with self._client.tx() as c:
+            c.executemany(
+                "INSERT OR REPLACE INTO events (event_id, app_id, channel_id,"
+                " event, entity_type, entity_id, target_entity_type,"
+                " target_entity_id, properties, event_time, tags, pr_id,"
+                " creation_time) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows)
         return ids
 
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
-        c = self._client.conn()
-        row = c.execute(
+        row = self._client.query_one(
             f"SELECT {_EVENT_COLS} FROM events WHERE app_id=? AND channel_id=?"
             " AND event_id=?",
-            (int(app_id), self._chan(channel_id), event_id)).fetchone()
+            (int(app_id), self._chan(channel_id), event_id))
         return _row_to_event(row) if row else None
 
     def delete(self, event_id, app_id, channel_id=None) -> bool:
-        c = self._client.conn()
-        cur = c.execute(
-            "DELETE FROM events WHERE app_id=? AND channel_id=? AND event_id=?",
-            (int(app_id), self._chan(channel_id), event_id))
-        c.commit()
-        return cur.rowcount > 0
+        with self._client.tx() as c:
+            cur = c.execute(
+                "DELETE FROM events WHERE app_id=? AND channel_id=?"
+                " AND event_id=?",
+                (int(app_id), self._chan(channel_id), event_id))
+            return cur.rowcount > 0
 
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,
              entity_type=None, entity_id=None, event_names=None,
@@ -282,143 +400,149 @@ class SqliteLEvents(base.LEvents):
                f"ORDER BY event_time {order}")
         if limit is not None and limit >= 0:
             sql += f" LIMIT {int(limit)}"
-        c = self._client.conn()
-        for row in c.execute(sql, args):
+        for row in self._client.query(sql, args):
             yield _row_to_event(row)
 
 
 class SqlitePEvents(base.LEventsBackedPEvents):
     def __init__(self, config: Optional[dict] = None):
-        levents = SqliteLEvents(config)
-        super().__init__(levents)
-        self._levents = levents
+        super().__init__(SqliteLEvents(config))
 
-    def write(self, events, app_id, channel_id=None) -> None:
-        self._levents.insert_batch(events, app_id, channel_id)
+    def shutdown(self) -> None:
+        self._l.shutdown()
 
 
-class SqliteApps(base.Apps):
+class _SqliteMetaDAO:
+    """Shared client plumbing for the metadata/model DAOs."""
+
     def __init__(self, config: Optional[dict] = None):
         self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
 
+    def close(self) -> None:
+        self._c.close()
+
+    def shutdown(self) -> None:
+        self._c.release()
+
+
+class SqliteApps(_SqliteMetaDAO, base.Apps):
+
     def insert(self, app: App) -> Optional[int]:
-        c = self._c.conn()
         try:
-            if app.id:
-                cur = c.execute(
-                    "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
-                    (app.id, app.name, app.description))
-            else:
-                cur = c.execute(
-                    "INSERT INTO apps (name, description) VALUES (?,?)",
-                    (app.name, app.description))
-            c.commit()
-            return cur.lastrowid if not app.id else app.id
+            with self._c.tx() as c:
+                if app.id:
+                    cur = c.execute(
+                        "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
+                        (app.id, app.name, app.description))
+                else:
+                    cur = c.execute(
+                        "INSERT INTO apps (name, description) VALUES (?,?)",
+                        (app.name, app.description))
+                return cur.lastrowid if not app.id else app.id
         except sqlite3.IntegrityError:
             return None
 
     def get(self, app_id):
-        row = self._c.conn().execute(
+        row = self._c.query_one(
             "SELECT id, name, description FROM apps WHERE id=?",
-            (int(app_id),)).fetchone()
+            (int(app_id),))
         return App(*row) if row else None
 
     def get_by_name(self, name):
-        row = self._c.conn().execute(
-            "SELECT id, name, description FROM apps WHERE name=?",
-            (name,)).fetchone()
+        row = self._c.query_one(
+            "SELECT id, name, description FROM apps WHERE name=?", (name,))
         return App(*row) if row else None
 
     def get_all(self):
-        return [App(*r) for r in self._c.conn().execute(
+        return [App(*r) for r in self._c.query(
             "SELECT id, name, description FROM apps ORDER BY id")]
 
     def update(self, app: App) -> bool:
-        c = self._c.conn()
-        cur = c.execute("UPDATE apps SET name=?, description=? WHERE id=?",
-                        (app.name, app.description, app.id))
-        c.commit()
-        return cur.rowcount > 0
+        with self._c.tx() as c:
+            cur = c.execute("UPDATE apps SET name=?, description=? WHERE id=?",
+                            (app.name, app.description, app.id))
+            return cur.rowcount > 0
 
     def delete(self, app_id) -> bool:
-        c = self._c.conn()
-        cur = c.execute("DELETE FROM apps WHERE id=?", (int(app_id),))
-        c.commit()
-        return cur.rowcount > 0
+        with self._c.tx() as c:
+            cur = c.execute("DELETE FROM apps WHERE id=?", (int(app_id),))
+            return cur.rowcount > 0
 
 
-class SqliteAccessKeys(base.AccessKeys):
-    def __init__(self, config: Optional[dict] = None):
-        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
+class SqliteAccessKeys(_SqliteMetaDAO, base.AccessKeys):
 
     def insert(self, k: AccessKey) -> Optional[str]:
         key = k.key or base.generate_access_key()
-        c = self._c.conn()
-        c.execute("INSERT OR REPLACE INTO access_keys (key, appid, events)"
-                  " VALUES (?,?,?)", (key, k.appid, json.dumps(list(k.events))))
-        c.commit()
+        with self._c.tx() as c:
+            c.execute("INSERT OR REPLACE INTO access_keys (key, appid, events)"
+                      " VALUES (?,?,?)",
+                      (key, k.appid, json.dumps(list(k.events))))
         return key
 
     def get(self, key):
-        row = self._c.conn().execute(
-            "SELECT key, appid, events FROM access_keys WHERE key=?",
-            (key,)).fetchone()
+        row = self._c.query_one(
+            "SELECT key, appid, events FROM access_keys WHERE key=?", (key,))
         return AccessKey(row[0], row[1], tuple(json.loads(row[2]))) if row else None
 
     def get_all(self):
         return [AccessKey(r[0], r[1], tuple(json.loads(r[2])))
-                for r in self._c.conn().execute(
+                for r in self._c.query(
                     "SELECT key, appid, events FROM access_keys")]
 
     def get_by_appid(self, appid):
         return [AccessKey(r[0], r[1], tuple(json.loads(r[2])))
-                for r in self._c.conn().execute(
+                for r in self._c.query(
                     "SELECT key, appid, events FROM access_keys WHERE appid=?",
                     (int(appid),))]
 
     def update(self, k: AccessKey) -> bool:
-        c = self._c.conn()
-        cur = c.execute("UPDATE access_keys SET appid=?, events=? WHERE key=?",
-                        (k.appid, json.dumps(list(k.events)), k.key))
-        c.commit()
-        return cur.rowcount > 0
+        with self._c.tx() as c:
+            cur = c.execute(
+                "UPDATE access_keys SET appid=?, events=? WHERE key=?",
+                (k.appid, json.dumps(list(k.events)), k.key))
+            return cur.rowcount > 0
 
     def delete(self, key) -> bool:
-        c = self._c.conn()
-        cur = c.execute("DELETE FROM access_keys WHERE key=?", (key,))
-        c.commit()
-        return cur.rowcount > 0
+        with self._c.tx() as c:
+            cur = c.execute("DELETE FROM access_keys WHERE key=?", (key,))
+            return cur.rowcount > 0
 
 
-class SqliteChannels(base.Channels):
-    def __init__(self, config: Optional[dict] = None):
-        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
+class SqliteChannels(_SqliteMetaDAO, base.Channels):
 
     def insert(self, c: Channel) -> Optional[int]:
         if not Channel.is_valid_name(c.name):
             return None
-        conn = self._c.conn()
-        cur = conn.execute("INSERT INTO channels (name, appid) VALUES (?,?)",
-                           (c.name, c.appid))
-        conn.commit()
-        return cur.lastrowid
+        try:
+            with self._c.tx() as conn:
+                if c.id:
+                    cur = conn.execute(
+                        "INSERT INTO channels (id, name, appid) VALUES (?,?,?)",
+                        (c.id, c.name, c.appid))
+                else:
+                    cur = conn.execute(
+                        "INSERT INTO channels (name, appid) VALUES (?,?)",
+                        (c.name, c.appid))
+                return c.id if c.id else cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
 
     def get(self, channel_id):
-        row = self._c.conn().execute(
+        row = self._c.query_one(
             "SELECT id, name, appid FROM channels WHERE id=?",
-            (int(channel_id),)).fetchone()
+            (int(channel_id),))
         return Channel(*row) if row else None
 
     def get_by_appid(self, appid):
-        return [Channel(*r) for r in self._c.conn().execute(
+        return [Channel(*r) for r in self._c.query(
             "SELECT id, name, appid FROM channels WHERE appid=?",
             (int(appid),))]
 
     def delete(self, channel_id) -> bool:
-        c = self._c.conn()
-        cur = c.execute("DELETE FROM channels WHERE id=?", (int(channel_id),))
-        c.commit()
-        return cur.rowcount > 0
+        with self._c.tx() as c:
+            cur = c.execute("DELETE FROM channels WHERE id=?",
+                            (int(channel_id),))
+            return cur.rowcount > 0
 
 
 _EI_COLS = ("id, status, start_time, end_time, engine_id, engine_version,"
@@ -436,37 +560,33 @@ def _row_to_ei(r) -> EngineInstance:
         preparator_params=r[12], algorithms_params=r[13], serving_params=r[14])
 
 
-class SqliteEngineInstances(base.EngineInstances):
-    def __init__(self, config: Optional[dict] = None):
-        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
-        self._lock = threading.Lock()
+class SqliteEngineInstances(_SqliteMetaDAO, base.EngineInstances):
 
     def insert(self, i: EngineInstance) -> str:
         iid = i.id or new_ei_id()
         i = dataclasses.replace(i, id=iid)
-        c = self._c.conn()
-        c.execute(
-            f"INSERT OR REPLACE INTO engine_instances ({_EI_COLS})"
-            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
-            (i.id, i.status, _ts(i.start_time), _ts(i.end_time), i.engine_id,
-             i.engine_version, i.engine_variant, i.engine_factory, i.batch,
-             json.dumps(i.env), json.dumps(i.spark_conf), i.data_source_params,
-             i.preparator_params, i.algorithms_params, i.serving_params))
-        c.commit()
+        with self._c.tx() as c:
+            c.execute(
+                f"INSERT OR REPLACE INTO engine_instances ({_EI_COLS})"
+                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (i.id, i.status, _ts(i.start_time), _ts(i.end_time),
+                 i.engine_id, i.engine_version, i.engine_variant,
+                 i.engine_factory, i.batch, json.dumps(i.env),
+                 json.dumps(i.spark_conf), i.data_source_params,
+                 i.preparator_params, i.algorithms_params, i.serving_params))
         return iid
 
     def get(self, iid):
-        row = self._c.conn().execute(
-            f"SELECT {_EI_COLS} FROM engine_instances WHERE id=?",
-            (iid,)).fetchone()
+        row = self._c.query_one(
+            f"SELECT {_EI_COLS} FROM engine_instances WHERE id=?", (iid,))
         return _row_to_ei(row) if row else None
 
     def get_all(self):
-        return [_row_to_ei(r) for r in self._c.conn().execute(
+        return [_row_to_ei(r) for r in self._c.query(
             f"SELECT {_EI_COLS} FROM engine_instances")]
 
     def get_completed(self, engine_id, engine_version, engine_variant):
-        return [_row_to_ei(r) for r in self._c.conn().execute(
+        return [_row_to_ei(r) for r in self._c.query(
             f"SELECT {_EI_COLS} FROM engine_instances WHERE status='COMPLETED'"
             " AND engine_id=? AND engine_version=? AND engine_variant=?"
             " ORDER BY start_time DESC",
@@ -477,25 +597,24 @@ class SqliteEngineInstances(base.EngineInstances):
         return rows[0] if rows else None
 
     def update(self, i: EngineInstance) -> bool:
-        c = self._c.conn()
-        cur = c.execute(
-            "UPDATE engine_instances SET status=?, start_time=?, end_time=?,"
-            " engine_id=?, engine_version=?, engine_variant=?,"
-            " engine_factory=?, batch=?, env=?, spark_conf=?,"
-            " data_source_params=?, preparator_params=?, algorithms_params=?,"
-            " serving_params=? WHERE id=?",
-            (i.status, _ts(i.start_time), _ts(i.end_time), i.engine_id,
-             i.engine_version, i.engine_variant, i.engine_factory, i.batch,
-             json.dumps(i.env), json.dumps(i.spark_conf), i.data_source_params,
-             i.preparator_params, i.algorithms_params, i.serving_params, i.id))
-        c.commit()
-        return cur.rowcount > 0
+        with self._c.tx() as c:
+            cur = c.execute(
+                "UPDATE engine_instances SET status=?, start_time=?,"
+                " end_time=?, engine_id=?, engine_version=?, engine_variant=?,"
+                " engine_factory=?, batch=?, env=?, spark_conf=?,"
+                " data_source_params=?, preparator_params=?,"
+                " algorithms_params=?, serving_params=? WHERE id=?",
+                (i.status, _ts(i.start_time), _ts(i.end_time), i.engine_id,
+                 i.engine_version, i.engine_variant, i.engine_factory, i.batch,
+                 json.dumps(i.env), json.dumps(i.spark_conf),
+                 i.data_source_params, i.preparator_params,
+                 i.algorithms_params, i.serving_params, i.id))
+            return cur.rowcount > 0
 
     def delete(self, iid) -> bool:
-        c = self._c.conn()
-        cur = c.execute("DELETE FROM engine_instances WHERE id=?", (iid,))
-        c.commit()
-        return cur.rowcount > 0
+        with self._c.tx() as c:
+            cur = c.execute("DELETE FROM engine_instances WHERE id=?", (iid,))
+            return cur.rowcount > 0
 
 
 _EVI_COLS = ("id, status, start_time, end_time, evaluation_class,"
@@ -511,80 +630,72 @@ def _row_to_evi(r) -> EvaluationInstance:
         evaluator_results_html=r[9], evaluator_results_json=r[10])
 
 
-class SqliteEvaluationInstances(base.EvaluationInstances):
-    def __init__(self, config: Optional[dict] = None):
-        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
+class SqliteEvaluationInstances(_SqliteMetaDAO, base.EvaluationInstances):
 
     def insert(self, i: EvaluationInstance) -> str:
         iid = i.id or new_ei_id("evi")
         i = dataclasses.replace(i, id=iid)
-        c = self._c.conn()
-        c.execute(
-            f"INSERT OR REPLACE INTO evaluation_instances ({_EVI_COLS})"
-            " VALUES (?,?,?,?,?,?,?,?,?,?,?)",
-            (i.id, i.status, _ts(i.start_time), _ts(i.end_time),
-             i.evaluation_class, i.engine_params_generator_class, i.batch,
-             json.dumps(i.env), i.evaluator_results, i.evaluator_results_html,
-             i.evaluator_results_json))
-        c.commit()
+        with self._c.tx() as c:
+            c.execute(
+                f"INSERT OR REPLACE INTO evaluation_instances ({_EVI_COLS})"
+                " VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                (i.id, i.status, _ts(i.start_time), _ts(i.end_time),
+                 i.evaluation_class, i.engine_params_generator_class, i.batch,
+                 json.dumps(i.env), i.evaluator_results,
+                 i.evaluator_results_html, i.evaluator_results_json))
         return iid
 
     def get(self, iid):
-        row = self._c.conn().execute(
-            f"SELECT {_EVI_COLS} FROM evaluation_instances WHERE id=?",
-            (iid,)).fetchone()
+        row = self._c.query_one(
+            f"SELECT {_EVI_COLS} FROM evaluation_instances WHERE id=?", (iid,))
         return _row_to_evi(row) if row else None
 
     def get_all(self):
-        return [_row_to_evi(r) for r in self._c.conn().execute(
+        return [_row_to_evi(r) for r in self._c.query(
             f"SELECT {_EVI_COLS} FROM evaluation_instances")]
 
     def get_completed(self):
-        return [_row_to_evi(r) for r in self._c.conn().execute(
+        return [_row_to_evi(r) for r in self._c.query(
             f"SELECT {_EVI_COLS} FROM evaluation_instances"
             " WHERE status='EVALCOMPLETED' ORDER BY start_time DESC")]
 
     def update(self, i: EvaluationInstance) -> bool:
-        c = self._c.conn()
-        cur = c.execute(
-            "UPDATE evaluation_instances SET status=?, start_time=?,"
-            " end_time=?, evaluation_class=?, engine_params_generator_class=?,"
-            " batch=?, env=?, evaluator_results=?, evaluator_results_html=?,"
-            " evaluator_results_json=? WHERE id=?",
-            (i.status, _ts(i.start_time), _ts(i.end_time), i.evaluation_class,
-             i.engine_params_generator_class, i.batch, json.dumps(i.env),
-             i.evaluator_results, i.evaluator_results_html,
-             i.evaluator_results_json, i.id))
-        c.commit()
-        return cur.rowcount > 0
+        with self._c.tx() as c:
+            cur = c.execute(
+                "UPDATE evaluation_instances SET status=?, start_time=?,"
+                " end_time=?, evaluation_class=?,"
+                " engine_params_generator_class=?, batch=?, env=?,"
+                " evaluator_results=?, evaluator_results_html=?,"
+                " evaluator_results_json=? WHERE id=?",
+                (i.status, _ts(i.start_time), _ts(i.end_time),
+                 i.evaluation_class, i.engine_params_generator_class, i.batch,
+                 json.dumps(i.env), i.evaluator_results,
+                 i.evaluator_results_html, i.evaluator_results_json, i.id))
+            return cur.rowcount > 0
 
     def delete(self, iid) -> bool:
-        c = self._c.conn()
-        cur = c.execute("DELETE FROM evaluation_instances WHERE id=?", (iid,))
-        c.commit()
-        return cur.rowcount > 0
+        with self._c.tx() as c:
+            cur = c.execute("DELETE FROM evaluation_instances WHERE id=?",
+                            (iid,))
+            return cur.rowcount > 0
 
 
-class SqliteModels(base.Models):
-    def __init__(self, config: Optional[dict] = None):
-        self._c = SqliteClient.shared((config or {}).get("path", ":memory:"))
+class SqliteModels(_SqliteMetaDAO, base.Models):
 
     def insert(self, m: Model) -> None:
-        c = self._c.conn()
-        c.execute("INSERT OR REPLACE INTO models (id, models) VALUES (?,?)",
-                  (m.id, m.models))
-        c.commit()
+        with self._c.tx() as c:
+            c.execute("INSERT OR REPLACE INTO models (id, models) VALUES (?,?)",
+                      (m.id, m.models))
 
     def get(self, mid):
-        row = self._c.conn().execute(
-            "SELECT id, models FROM models WHERE id=?", (mid,)).fetchone()
+        row = self._c.query_one(
+            "SELECT id, models FROM models WHERE id=?", (mid,))
         return Model(row[0], row[1]) if row else None
 
     def delete(self, mid) -> bool:
-        c = self._c.conn()
-        cur = c.execute("DELETE FROM models WHERE id=?", (mid,))
-        c.commit()
-        return cur.rowcount > 0
+        with self._c.tx() as c:
+            cur = c.execute("DELETE FROM models WHERE id=?", (mid,))
+            return cur.rowcount > 0
 
 
 def new_ei_id(prefix: str = "ei") -> str:

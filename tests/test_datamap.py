@@ -49,6 +49,18 @@ class TestDataMap:
     def test_equality_with_mapping(self):
         assert DataMap({"a": 1}) == {"a": 1}
 
+    def test_get_mapping_semantics(self):
+        # ADVICE r1: dm.get(key, default) must behave like Mapping.get
+        d = DataMap({"a": 1})
+        assert d.get("a", 0) == 1
+        assert d.get("missing", "fallback") == "fallback"
+        assert d.get("missing", None) is None
+        # typed accessor still works alongside
+        assert d.get("a", int, 7) == 1
+        assert d.get("missing", int, 7) == 7
+        with pytest.raises(TypeError):
+            d.get("a", 0, 1)  # non-type typ with explicit default
+
 
 class TestEntityMap:
     def test_indexing(self):

@@ -130,6 +130,14 @@ class TestLEvents:
         assert len(list(le.find(1))) == 0
         assert len(list(le.find(2))) == 1
 
+    def test_insert_batch(self, backend):
+        le = backend["levents"]
+        le.init(APP)
+        ids = le.insert_batch([mk(i) for i in range(3)], APP)
+        assert len(ids) == len(set(ids)) == 3
+        assert len(list(le.find(APP))) == 3
+        assert le.get(ids[0], APP) is not None
+
     def test_aggregate_properties(self, backend):
         le = backend["levents"]
         le.init(APP)
@@ -159,6 +167,19 @@ class TestMetadata:
         assert [a.id for a in apps.get_all()] == [aid]
         assert apps.delete(aid)
         assert apps.get(aid) is None
+
+    def test_apps_explicit_id_conflict(self, backend):
+        apps = backend["apps"]
+        assert apps.insert(App(5, "one")) == 5
+        # requested id already taken -> None in EVERY backend
+        assert apps.insert(App(5, "two")) is None
+        assert apps.get_by_name("two") is None
+
+    def test_channels_explicit_id(self, backend):
+        ch = backend["channels"]
+        assert ch.insert(Channel(9, "mobile", 12)) == 9
+        assert ch.get(9).name == "mobile"
+        assert ch.insert(Channel(9, "web", 12)) is None
 
     def test_access_keys(self, backend):
         ak = backend["access_keys"]
@@ -218,6 +239,63 @@ class TestMetadata:
         assert m.get("m1") is None
 
 
+class TestSqliteConcurrency:
+    """ADVICE r1: ':memory:' must be one shared database across threads."""
+
+    def test_memory_db_shared_across_threads(self):
+        import threading
+        from predictionio_tpu.data.storage.sqlite import (
+            SqliteClient, SqliteLEvents)
+        SqliteClient.shutdown_all()
+        le = SqliteLEvents({})  # default :memory:
+        le.init(APP)
+        le.insert(mk(0), APP)
+        errors = []
+
+        def worker(i):
+            try:
+                le.insert(mk(i + 1), APP)
+                assert len(list(le.find(APP))) >= 2
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert errors == []
+        assert len(list(le.find(APP))) == 5
+        SqliteClient.shutdown_all()
+
+    def test_file_db_shared_across_threads(self, tmp_path):
+        import threading
+        from predictionio_tpu.data.storage.sqlite import (
+            SqliteClient, SqliteLEvents)
+        le = SqliteLEvents({"path": str(tmp_path / "threads.db")})
+        le.init(APP)
+
+        def worker(i):
+            le.insert(mk(i), APP)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(list(le.find(APP))) == 8
+        SqliteClient.shutdown_all()
+
+    def test_dao_close_does_not_break_sibling_daos(self, tmp_path):
+        from predictionio_tpu.data.storage.sqlite import (
+            SqliteApps, SqliteLEvents)
+        cfg = {"path": str(tmp_path / "shared.db")}
+        le, apps = SqliteLEvents(cfg), SqliteApps(cfg)
+        aid = apps.insert(App(0, "alive"))
+        le.close()  # no-op at DAO level
+        assert apps.get(aid).name == "alive"
+
+
 class TestRegistryAndFacades:
     def test_env_config_parsing(self, monkeypatch):
         from predictionio_tpu.data.storage import StorageConfig
@@ -227,12 +305,30 @@ class TestRegistryAndFacades:
             "PIO_STORAGE_SOURCES_SQL_PATH": "/tmp/x.db",
             "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
             "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
         })
         assert cfg.sources["SQL"]["path"] == "/tmp/x.db"
         assert cfg.repositories["METADATA"] == "SQL"
         assert cfg.repositories["EVENTDATA"] == "MEM"
-        # MODELDATA defaults to first source
-        assert cfg.repositories["MODELDATA"] in cfg.sources
+        assert cfg.repositories["MODELDATA"] == "SQL"
+
+    def test_unbound_repo_with_multiple_sources_raises(self):
+        from predictionio_tpu.data.storage import StorageConfig
+        from predictionio_tpu.data.storage.base import StorageError
+        with pytest.raises(StorageError, match="MODELDATA"):
+            StorageConfig.from_env({
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            })
+
+    def test_single_source_auto_binds(self):
+        from predictionio_tpu.data.storage import StorageConfig
+        cfg = StorageConfig.from_env({
+            "PIO_STORAGE_SOURCES_ONLY_TYPE": "memory",
+        })
+        assert all(src == "ONLY" for src in cfg.repositories.values())
 
     def test_unknown_backend_type(self):
         from predictionio_tpu.data.storage import StorageConfig
