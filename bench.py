@@ -1,0 +1,113 @@
+"""Benchmark: implicit ALS on MovieLens-100K-scale data, TPU vs CPU baseline.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The workload mirrors the reference's north-star template
+(``examples/scala-parallel-recommendation``, ALS.trainImplicit — see
+BASELINE.md). No published reference numbers exist, so the baseline is a
+faithful CPU reimplementation of the same batched normal-equation solves
+(numpy + multithreaded BLAS), per BASELINE.md's measurement plan. The data
+is synthetic at the MovieLens-100K shape (943 users x 1682 items x 100k
+ratings, power-law popularity) since the environment has no network egress.
+
+vs_baseline = CPU_time / device_time per epoch (>1 means faster than CPU).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+RANK = 64
+ITERATIONS = 10
+LAMBDA = 0.01
+ALPHA = 1.0
+N_USERS, N_ITEMS, NNZ = 943, 1682, 100_000
+
+
+def movielens_100k_shape(seed: int = 7):
+    """Synthetic ratings with power-law item popularity and user activity."""
+    rng = np.random.default_rng(seed)
+    # zipf-ish popularity, clipped to the catalog
+    item_p = 1.0 / np.arange(1, N_ITEMS + 1) ** 0.8
+    item_p /= item_p.sum()
+    user_p = 1.0 / np.arange(1, N_USERS + 1) ** 0.6
+    user_p /= user_p.sum()
+    rows = rng.choice(N_USERS, size=NNZ, p=user_p)
+    cols = rng.choice(N_ITEMS, size=NNZ, p=item_p)
+    vals = rng.integers(1, 6, size=NNZ).astype(np.float32)
+    return rows, cols, vals
+
+
+def numpy_baseline_epoch(user_side, item_side, rank, lam, alpha, seed):
+    """One full alternating epoch with numpy — the same padded batched
+    solves the device runs, on host BLAS threads (the 8-core CPU analog)."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(user_side.n_rows, rank)).astype(np.float32)
+    Y = rng.normal(size=(user_side.n_cols, rank)).astype(np.float32)
+
+    def solve_side(Y, cols, weights):
+        w = weights
+        mask = (w > 0).astype(np.float32)
+        Yg = Y[cols]                                   # [B, L, R]
+        gram = Y.T @ Y
+        corr = np.einsum("bl,blr,bls->brs", alpha * w, Yg, Yg,
+                         optimize=True)
+        A = corr + gram[None] + lam * np.eye(rank, dtype=np.float32)[None]
+        b = np.einsum("bl,blr->br", mask + alpha * w, Yg, optimize=True)
+        return np.linalg.solve(A, b[..., None])[..., 0]
+
+    t0 = time.perf_counter()
+    X = solve_side(Y, user_side.cols, user_side.weights)
+    Y = solve_side(X, item_side.cols, item_side.weights)
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    from predictionio_tpu.ops.als import ALSParams, pad_ratings, train_als
+
+    rows, cols, vals = movielens_100k_shape()
+    user_side = pad_ratings(rows, cols, vals, N_USERS, N_ITEMS)
+    item_side = pad_ratings(cols, rows, vals, N_ITEMS, N_USERS)
+    params = ALSParams(rank=RANK, num_iterations=ITERATIONS, lambda_=LAMBDA,
+                       alpha=ALPHA, seed=1)
+
+    # warm-up: compile (first call) — not timed
+    warm = ALSParams(rank=RANK, num_iterations=1, lambda_=LAMBDA,
+                     alpha=ALPHA, seed=1)
+    train_als(user_side, item_side, warm)
+
+    t0 = time.perf_counter()
+    X, Y = train_als(user_side, item_side, params)
+    device_total = time.perf_counter() - t0
+    assert np.isfinite(X).all() and np.isfinite(Y).all()
+    device_epoch = device_total / ITERATIONS
+    events_per_sec = NNZ / device_epoch
+
+    # CPU baseline: 2 epochs, take the best (steady-state)
+    cpu_epoch = min(
+        numpy_baseline_epoch(user_side, item_side, RANK, LAMBDA, ALPHA, s)
+        for s in (1, 2))
+
+    import jax
+
+    print(json.dumps({
+        "metric": "als_implicit_ml100k_rank64_events_per_sec",
+        "value": round(events_per_sec, 1),
+        "unit": "events/s/chip",
+        "vs_baseline": round(cpu_epoch / device_epoch, 2),
+        "detail": {
+            "device": str(jax.devices()[0]).strip(),
+            "epoch_sec": round(device_epoch, 4),
+            "cpu_epoch_sec": round(cpu_epoch, 4),
+            "rank": RANK, "iterations": ITERATIONS,
+            "n_users": N_USERS, "n_items": N_ITEMS, "nnz": NNZ,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,7 @@ C++ (ctypes) instead of JVM/akka, and persistence uses numpy/orbax
 instead of Kryo.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from predictionio_tpu.data.event import Event, EventValidationError, validate_event
 from predictionio_tpu.data.datamap import DataMap, PropertyMap, EntityMap

@@ -1,0 +1,78 @@
+"""Public controller API — the DASE surface users implement against.
+
+Mirrors the reference's ``io.prediction.controller`` package object: one
+import point for engines, controller flavors, params, and persistence.
+"""
+
+from predictionio_tpu.controller.algorithms import (
+    LAlgorithm, P2LAlgorithm, PAlgorithm,
+)
+from predictionio_tpu.controller.controllers import (
+    IdentityPreparator,
+    LAverageServing,
+    LDataSource,
+    LFirstServing,
+    LIdentityPreparator,
+    LPreparator,
+    LServing,
+    PDataSource,
+    PIdentityPreparator,
+    PPreparator,
+)
+from predictionio_tpu.controller.engine import (
+    Engine,
+    EngineConfigError,
+    EngineParams,
+    SimpleEngine,
+    params_from_dict,
+    params_to_dict,
+)
+from predictionio_tpu.controller.persistent import (
+    PersistentModel,
+    load_persistent_model,
+)
+from predictionio_tpu.core.base import (
+    RETRAIN,
+    EmptyParams,
+    Params,
+    PersistentModelManifest,
+    SanityCheck,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+)
+from predictionio_tpu.core.context import ComputeContext, workflow_context
+
+__all__ = [
+    "ComputeContext",
+    "EmptyParams",
+    "Engine",
+    "EngineConfigError",
+    "EngineParams",
+    "IdentityPreparator",
+    "LAlgorithm",
+    "LAverageServing",
+    "LDataSource",
+    "LFirstServing",
+    "LIdentityPreparator",
+    "LPreparator",
+    "LServing",
+    "P2LAlgorithm",
+    "PAlgorithm",
+    "PDataSource",
+    "PIdentityPreparator",
+    "PPreparator",
+    "Params",
+    "PersistentModel",
+    "PersistentModelManifest",
+    "RETRAIN",
+    "SanityCheck",
+    "SimpleEngine",
+    "StopAfterPrepareInterruption",
+    "StopAfterReadInterruption",
+    "WorkflowParams",
+    "load_persistent_model",
+    "params_from_dict",
+    "params_to_dict",
+    "workflow_context",
+]

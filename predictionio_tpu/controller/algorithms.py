@@ -1,0 +1,138 @@
+"""Algorithm flavors: local, parallel, and parallel-to-local.
+
+Parity targets: ``controller/LAlgorithm.scala:45-74``,
+``P2LAlgorithm.scala:43-121``, ``PAlgorithm.scala:44-126``. The Spark
+execution semantics translate to TPU-native ones:
+
+- :class:`LAlgorithm` — trains on the host from local prepared data; model
+  is a plain host object. (Reference: model trained inside one Spark task.)
+- :class:`P2LAlgorithm` — trains with the device mesh available via the
+  ComputeContext (sharded jax computation), but the finished model is pulled
+  back to host memory and is automatically serializable. This is the flavor
+  every reference ALS/NB template uses.
+- :class:`PAlgorithm` — the model itself stays device-resident / sharded
+  (too big for one host, cf. RDD models); it is NOT automatically
+  serializable: persist via PersistentModel or retrain at deploy
+  (PAlgorithm.scala makePersistentModel returns Unit).
+
+Default ``batch_predict`` implementations mirror the reference defaults:
+P2L maps ``predict`` over the query set (P2LAlgorithm.scala:66-68); L does
+the same host-side (the reference's cartesian trick exists only because the
+model lives in an RDD there).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Sequence, Tuple
+
+from predictionio_tpu.controller.persistent import PersistentModel, manifest_for
+from predictionio_tpu.core.base import RETRAIN, BaseAlgorithm, Params
+from predictionio_tpu.core.context import ComputeContext
+
+
+def _persist_or_model(model: Any, model_id: str, params: Params,
+                      ctx: ComputeContext) -> Any:
+    """Shared L/P2L persistence decision (LAlgorithm.scala:44-61):
+    PersistentModel -> save -> manifest (or RETRAIN if save declined);
+    anything else -> the model itself (automatic serialization)."""
+    if isinstance(model, PersistentModel):
+        if model.save(model_id, params, ctx):
+            return manifest_for(model)
+        return RETRAIN
+    return model
+
+
+class LAlgorithm(BaseAlgorithm):
+    """Local algorithm: host-only train/predict."""
+
+    @abc.abstractmethod
+    def train(self, pd: Any) -> Any: ...
+
+    @abc.abstractmethod
+    def predict(self, model: Any, query: Any) -> Any: ...
+
+    def batch_predict(self, model: Any,
+                      indexed_queries: Sequence[Tuple[int, Any]]
+                      ) -> List[Tuple[int, Any]]:
+        return [(qx, self.predict(model, q)) for qx, q in indexed_queries]
+
+    # -- Base plumbing ----------------------------------------------------
+    def train_base(self, ctx: ComputeContext, pd: Any) -> Any:
+        return self.train(pd)
+
+    def batch_predict_base(self, ctx, model, indexed_queries):
+        return self.batch_predict(model, indexed_queries)
+
+    def predict_base(self, model: Any, query: Any) -> Any:
+        return self.predict(model, query)
+
+    def make_persistent_model(self, ctx, model_id, algo_params, model):
+        return _persist_or_model(model, model_id, algo_params, ctx)
+
+
+class P2LAlgorithm(BaseAlgorithm):
+    """Parallel-to-local: train on the mesh, keep a host-local model."""
+
+    @abc.abstractmethod
+    def train(self, ctx: ComputeContext, pd: Any) -> Any: ...
+
+    @abc.abstractmethod
+    def predict(self, model: Any, query: Any) -> Any: ...
+
+    def batch_predict(self, ctx: ComputeContext, model: Any,
+                      indexed_queries: Sequence[Tuple[int, Any]]
+                      ) -> List[Tuple[int, Any]]:
+        """Default: map predict over queries (P2LAlgorithm.scala:66-68).
+        Override to batch queries into one device program."""
+        return [(qx, self.predict(model, q)) for qx, q in indexed_queries]
+
+    # -- Base plumbing ----------------------------------------------------
+    def train_base(self, ctx: ComputeContext, pd: Any) -> Any:
+        return self.train(ctx, pd)
+
+    def batch_predict_base(self, ctx, model, indexed_queries):
+        return self.batch_predict(ctx, model, indexed_queries)
+
+    def predict_base(self, model: Any, query: Any) -> Any:
+        return self.predict(model, query)
+
+    def make_persistent_model(self, ctx, model_id, algo_params, model):
+        return _persist_or_model(model, model_id, algo_params, ctx)
+
+
+class PAlgorithm(BaseAlgorithm):
+    """Parallel algorithm: device-resident / sharded model."""
+
+    @abc.abstractmethod
+    def train(self, ctx: ComputeContext, pd: Any) -> Any: ...
+
+    def batch_predict(self, ctx: ComputeContext, model: Any,
+                      indexed_queries: Sequence[Tuple[int, Any]]
+                      ) -> List[Tuple[int, Any]]:
+        """No default: a sharded model needs an explicit batched-predict
+        program (PAlgorithm.scala:69-77 leaves this to the implementation)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} must override batch_predict for "
+            "evaluation over a device-resident model")
+
+    @abc.abstractmethod
+    def predict(self, model: Any, query: Any) -> Any: ...
+
+    # -- Base plumbing ----------------------------------------------------
+    def train_base(self, ctx: ComputeContext, pd: Any) -> Any:
+        return self.train(ctx, pd)
+
+    def batch_predict_base(self, ctx, model, indexed_queries):
+        return self.batch_predict(ctx, model, indexed_queries)
+
+    def predict_base(self, model: Any, query: Any) -> Any:
+        return self.predict(model, query)
+
+    def make_persistent_model(self, ctx, model_id, algo_params, model):
+        """PersistentModel -> save/manifest; otherwise RETRAIN — a sharded
+        model is never pickled wholesale (PAlgorithm.scala:104-120)."""
+        if isinstance(model, PersistentModel):
+            if model.save(model_id, algo_params, ctx):
+                return manifest_for(model)
+        return RETRAIN

@@ -1,0 +1,121 @@
+"""DataSource / Preparator / Serving flavors.
+
+Parity targets: ``controller/PDataSource.scala``, ``LDataSource.scala``,
+``PPreparator.scala``, ``LPreparator.scala``, ``IdentityPreparator.scala:31,
+56,78``, ``LServing.scala:27-51``, ``LFirstServing.scala:25``,
+``LAverageServing.scala:25``.
+
+The L/P split loses its RDD-wrapping mechanics here (no RDDs); both
+flavors receive the ComputeContext, P-flavors by convention return data
+already laid out for device sharding (columnar numpy), L-flavors plain
+Python values.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Sequence, Tuple
+
+from predictionio_tpu.core.base import (
+    BaseDataSource, BasePreparator, BaseServing,
+)
+from predictionio_tpu.core.context import ComputeContext
+
+
+class PDataSource(BaseDataSource):
+    """Parallel data source (PDataSource.scala:37-71)."""
+
+    @abc.abstractmethod
+    def read_training(self, ctx: ComputeContext) -> Any: ...
+
+    def read_eval(self, ctx: ComputeContext
+                  ) -> Sequence[Tuple[Any, Any, Sequence[Tuple[Any, Any]]]]:
+        return []
+
+    def read_training_base(self, ctx):
+        return self.read_training(ctx)
+
+    def read_eval_base(self, ctx):
+        return self.read_eval(ctx)
+
+
+class LDataSource(BaseDataSource):
+    """Local data source (LDataSource.scala:37-71) — no context needed."""
+
+    @abc.abstractmethod
+    def read_training(self) -> Any: ...
+
+    def read_eval(self) -> Sequence[Tuple[Any, Any, Sequence[Tuple[Any, Any]]]]:
+        return []
+
+    def read_training_base(self, ctx):
+        return self.read_training()
+
+    def read_eval_base(self, ctx):
+        return self.read_eval()
+
+
+class PPreparator(BasePreparator):
+    """Parallel preparator (PPreparator.scala:35-44)."""
+
+    @abc.abstractmethod
+    def prepare(self, ctx: ComputeContext, td: Any) -> Any: ...
+
+    def prepare_base(self, ctx, td):
+        return self.prepare(ctx, td)
+
+
+class LPreparator(BasePreparator):
+    """Local preparator (LPreparator.scala:35-44)."""
+
+    @abc.abstractmethod
+    def prepare(self, td: Any) -> Any: ...
+
+    def prepare_base(self, ctx, td):
+        return self.prepare(td)
+
+
+class IdentityPreparator(BasePreparator):
+    """TD passes through unchanged (IdentityPreparator.scala:31); works for
+    both flavors here since nothing wraps RDDs."""
+
+    def prepare_base(self, ctx, td):
+        return td
+
+
+# Reference aliases (IdentityPreparator.scala:56,78)
+PIdentityPreparator = IdentityPreparator
+LIdentityPreparator = IdentityPreparator
+
+
+class LServing(BaseServing):
+    """Local serving (LServing.scala:27-51)."""
+
+    def supplement(self, query: Any) -> Any:
+        """Pre-predict query enrichment; default identity
+        (LServing.scala:30-37)."""
+        return query
+
+    @abc.abstractmethod
+    def serve(self, query: Any, predictions: Sequence[Any]) -> Any: ...
+
+    def supplement_base(self, query):
+        return self.supplement(query)
+
+    def serve_base(self, query, predictions):
+        return self.serve(query, predictions)
+
+
+class LFirstServing(LServing):
+    """Returns the first algorithm's prediction (LFirstServing.scala:25)."""
+
+    def serve(self, query: Any, predictions: Sequence[Any]) -> Any:
+        return predictions[0]
+
+
+class LAverageServing(LServing):
+    """Averages numeric predictions (LAverageServing.scala:25)."""
+
+    def serve(self, query: Any, predictions: Sequence[Any]) -> Any:
+        ps: List[float] = [float(p) for p in predictions]
+        return sum(ps) / len(ps)

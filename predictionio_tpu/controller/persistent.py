@@ -1,0 +1,58 @@
+"""PersistentModel — custom model persistence (mode 2 of 3).
+
+Parity: ``controller/PersistentModel.scala:64-100`` — models that cannot be
+serialized automatically (e.g. factor matrices kept sharded in HBM, or
+written to a column store) implement ``save``; a loader restores them at
+deploy. The reference resolves the loader companion object reflectively
+(``WorkflowUtils.scala:352-384``); here the manifest records
+``module:Class`` and ``load`` is a classmethod — one clean path, no
+reflection stack.
+"""
+
+from __future__ import annotations
+
+import abc
+import importlib
+from typing import Any, Optional
+
+from predictionio_tpu.core.base import Params, PersistentModelManifest
+from predictionio_tpu.core.context import ComputeContext
+
+
+class PersistentModel(abc.ABC):
+    """Implement both methods; ``save`` returning False means "do not
+    persist, retrain at deploy" (PersistentModel.scala:73-79 contract)."""
+
+    @abc.abstractmethod
+    def save(self, model_id: str, params: Params,
+             ctx: Optional[ComputeContext] = None) -> bool: ...
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, model_id: str, params: Params,
+             ctx: Optional[ComputeContext] = None) -> "PersistentModel": ...
+
+
+def class_path(obj: Any) -> str:
+    cls = obj if isinstance(obj, type) else type(obj)
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def manifest_for(model: PersistentModel) -> PersistentModelManifest:
+    return PersistentModelManifest(class_path=class_path(model))
+
+
+def load_persistent_model(manifest: PersistentModelManifest, model_id: str,
+                          params: Params,
+                          ctx: Optional[ComputeContext] = None) -> Any:
+    """Resolve the class from the manifest and load
+    (SparkWorkflowUtils.getPersistentModel analog)."""
+    mod_name, _, cls_name = manifest.class_path.partition(":")
+    mod = importlib.import_module(mod_name)
+    cls: Any = mod
+    for part in cls_name.split("."):
+        cls = getattr(cls, part)
+    if not (isinstance(cls, type) and issubclass(cls, PersistentModel)):
+        raise TypeError(
+            f"{manifest.class_path} is not a PersistentModel subclass")
+    return cls.load(model_id, params, ctx)

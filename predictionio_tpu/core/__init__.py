@@ -1,0 +1,44 @@
+"""Core base abstractions for the DASE pipeline.
+
+Parity targets: reference ``core/src/main/scala/io/prediction/core/``
+(BaseEngine, BaseDataSource, BasePreparator, BaseAlgorithm, BaseServing,
+AbstractDoer) — redesigned for a TPU host process: SparkContext is replaced
+by :class:`ComputeContext` (a jax device mesh + config), RDDs by host
+arrays/lists that the data plane shards onto the mesh.
+"""
+
+from predictionio_tpu.core.base import (
+    RETRAIN,
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Doer,
+    EmptyParams,
+    Params,
+    PersistentModelManifest,
+    SanityCheck,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    TrainingInterruption,
+    WorkflowParams,
+)
+from predictionio_tpu.core.context import ComputeContext
+
+__all__ = [
+    "RETRAIN",
+    "BaseAlgorithm",
+    "BaseDataSource",
+    "BasePreparator",
+    "BaseServing",
+    "ComputeContext",
+    "Doer",
+    "EmptyParams",
+    "Params",
+    "PersistentModelManifest",
+    "SanityCheck",
+    "StopAfterPrepareInterruption",
+    "StopAfterReadInterruption",
+    "TrainingInterruption",
+    "WorkflowParams",
+]

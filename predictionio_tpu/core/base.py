@@ -1,0 +1,226 @@
+"""Base contracts for the DASE pipeline.
+
+Parity targets (behavior, not structure):
+- ``BaseDataSource.readTrainingBase/readEvalBase`` (reference
+  ``core/.../core/BaseDataSource.scala:40,51``)
+- ``BasePreparator.prepareBase`` (``BasePreparator.scala``)
+- ``BaseAlgorithm.trainBase/batchPredictBase/predictBase/
+  makePersistentModel`` (``BaseAlgorithm.scala:66-122``)
+- ``BaseServing.supplementBase/serveBase`` (``BaseServing.scala``)
+- ``AbstractDoer``/``Doer`` factory (``AbstractDoer.scala:32-65``) — here a
+  plain constructor call: controllers take one ``params`` argument.
+- Workflow control: sanity checks and stop-after interruptions
+  (``Engine.scala:649-687``, ``WorkflowUtils.scala:411-415``).
+
+Type parameters from the reference map to duck-typed Python values:
+TD training data, EI evaluation info, PD prepared data, Q query,
+P prediction, A actual. Spark RDDs become host values (lists / numpy /
+jax arrays) that algorithms shard onto the mesh via the ComputeContext.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import (
+    Any, Callable, Dict, List, Optional, Protocol, Sequence, Tuple,
+    runtime_checkable,
+)
+
+from predictionio_tpu.core.context import ComputeContext
+
+
+class Params:
+    """Marker base for controller hyper-parameter bundles
+    (``Params.scala:23``). Use ``@dataclass`` subclasses."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    """``EmptyParams()`` (Params.scala:29)."""
+
+
+@dataclasses.dataclass
+class WorkflowParams:
+    """Training-process controls (``WorkflowParams`` in Workflow.scala).
+
+    ``stop_after_read``/``stop_after_prepare`` reproduce the CLI debug
+    interruptions (``Engine.scala:663-687``).
+    """
+
+    batch: str = ""
+    verbose: int = 2
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+
+
+class TrainingInterruption(Exception):
+    """Base for deliberate workflow interruptions (WorkflowUtils.scala:411)."""
+
+
+class StopAfterReadInterruption(TrainingInterruption):
+    pass
+
+
+class StopAfterPrepareInterruption(TrainingInterruption):
+    pass
+
+
+@runtime_checkable
+class SanityCheck(Protocol):
+    """Objects opting into data sanity checking (``SanityCheck.scala``):
+    ``sanity_check`` raises on bad data."""
+
+    def sanity_check(self) -> None: ...
+
+
+def run_sanity_check(obj: Any) -> None:
+    """Perform the check iff the object supports it (Engine.scala:649-661)."""
+    if isinstance(obj, SanityCheck):
+        obj.sanity_check()
+
+
+# ---------------------------------------------------------------------------
+# Model persistence sentinels (BaseAlgorithm.scala:107-112 three modes)
+# ---------------------------------------------------------------------------
+
+class _Retrain:
+    """Sentinel: model was not persisted; retrain at deploy
+    (the reference returns Unit, ``Engine.scala:208-230``)."""
+
+    _instance: Optional["_Retrain"] = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "RETRAIN"
+
+    def __reduce__(self):  # pickles to the singleton
+        return (_Retrain, ())
+
+
+RETRAIN = _Retrain()
+
+
+@dataclasses.dataclass(frozen=True)
+class PersistentModelManifest:
+    """Marker persisted in place of a custom-saved model
+    (``PersistentModelManifest`` in PersistentModel workflow); ``class_path``
+    is ``module:Class`` of the PersistentModel implementation."""
+
+    class_path: str
+
+
+# ---------------------------------------------------------------------------
+# Controller bases
+# ---------------------------------------------------------------------------
+
+class AbstractDoer:
+    """Controllers are constructed with exactly one ``params`` argument
+    (AbstractDoer.scala:32). Subclasses may declare ``params_class`` for
+    typed JSON extraction."""
+
+    params_class: Optional[type] = None
+
+    def __init__(self, params: Optional[Params] = None):
+        self.params = params if params is not None else EmptyParams()
+
+
+def Doer(clazz: type, params: Optional[Params] = None) -> Any:
+    """Instantiate a controller with params (``Doer.apply``,
+    AbstractDoer.scala:47-65)."""
+    return clazz(params)
+
+
+class BaseDataSource(AbstractDoer, abc.ABC):
+    """Reads training and evaluation data (BaseDataSource.scala:33-58)."""
+
+    @abc.abstractmethod
+    def read_training_base(self, ctx: ComputeContext) -> Any:
+        """Return TD."""
+
+    def read_eval_base(
+        self, ctx: ComputeContext
+    ) -> Sequence[Tuple[Any, Any, Sequence[Tuple[Any, Any]]]]:
+        """Return eval sets ``[(TD, EI, [(Q, A), ...]), ...]``; default none
+        (BaseDataSource.scala:51-56 returns empty)."""
+        return []
+
+
+class BasePreparator(AbstractDoer, abc.ABC):
+    """TD -> PD (BasePreparator.scala:33-44)."""
+
+    @abc.abstractmethod
+    def prepare_base(self, ctx: ComputeContext, td: Any) -> Any: ...
+
+
+class BaseAlgorithm(AbstractDoer, abc.ABC):
+    """The central contract (BaseAlgorithm.scala:36-122)."""
+
+    @abc.abstractmethod
+    def train_base(self, ctx: ComputeContext, pd: Any) -> Any:
+        """PD -> model."""
+
+    @abc.abstractmethod
+    def batch_predict_base(
+        self, ctx: ComputeContext, model: Any,
+        indexed_queries: Sequence[Tuple[int, Any]],
+    ) -> List[Tuple[int, Any]]:
+        """Predict for indexed queries (evaluation path,
+        BaseAlgorithm.scala:78-88)."""
+
+    @abc.abstractmethod
+    def predict_base(self, model: Any, query: Any) -> Any:
+        """Single-query predict (serving path, BaseAlgorithm.scala:90-98)."""
+
+    def make_persistent_model(self, ctx: ComputeContext, model_id: str,
+                              algo_params: Params, model: Any) -> Any:
+        """Convert a trained model into its persisted form: the model itself
+        (automatic serialization), a PersistentModelManifest (custom save), or
+        RETRAIN (re-train at deploy). Default: do not persist
+        (BaseAlgorithm.scala:107-112 returns Unit)."""
+        return RETRAIN
+
+    def query_class(self) -> Optional[type]:
+        """Query type for JSON extraction at serving time
+        (BaseAlgorithm.scala:118-122); None means raw dict queries."""
+        return getattr(self, "query_cls", None)
+
+
+class BaseServing(AbstractDoer, abc.ABC):
+    """Query supplement + prediction combination (BaseServing.scala:33-48)."""
+
+    def supplement_base(self, query: Any) -> Any:
+        return query
+
+    @abc.abstractmethod
+    def serve_base(self, query: Any, predictions: Sequence[Any]) -> Any: ...
+
+
+class BaseEvaluatorResult:
+    """Evaluation output renderings (BaseEvaluatorResult.scala:57-72)."""
+
+    #: When True the result is not persisted (FakeWorkflow uses this).
+    no_save: bool = False
+
+    def to_one_liner(self) -> str:
+        return ""
+
+    def to_html(self) -> str:
+        return ""
+
+    def to_json(self) -> str:
+        return ""
+
+
+class BaseEvaluator(AbstractDoer, abc.ABC):
+    """Scores eval output (BaseEvaluator.scala:49)."""
+
+    @abc.abstractmethod
+    def evaluate_base(self, ctx: ComputeContext, evaluation: Any,
+                      engine_eval_data_set: Sequence[Tuple[Any, Any]],
+                      params: WorkflowParams) -> BaseEvaluatorResult: ...

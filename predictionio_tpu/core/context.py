@@ -1,0 +1,77 @@
+"""ComputeContext — the TPU-native replacement for SparkContext.
+
+The reference threads a SparkContext through every controller call
+(``core/.../core/BaseDataSource.scala:40``, ``BaseAlgorithm.scala:66``).
+Here the equivalent handle is a jax device mesh plus workflow metadata:
+controllers that shard work across chips receive the mesh and annotate
+shardings; local controllers ignore it. jax is imported lazily so
+storage-only tooling doesn't pay the import cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class ComputeContext:
+    """Devices + mesh handle passed to every DASE stage.
+
+    ``mesh_shape``/``axis_names`` describe the logical mesh laid over
+    ``devices``; ``mesh`` materializes a ``jax.sharding.Mesh``. ``mode``
+    mirrors the reference WorkflowContext app-name tagging
+    (``WorkflowContext.scala:26-43``): "train" | "eval" | "serving".
+    """
+
+    mode: str = "train"
+    batch: str = ""
+    mesh_shape: Optional[Tuple[int, ...]] = None
+    axis_names: Tuple[str, ...] = ("data",)
+    _devices: Optional[Sequence[Any]] = None
+    _mesh: Any = None
+
+    @property
+    def devices(self) -> Sequence[Any]:
+        if self._devices is None:
+            import jax
+
+            self._devices = tuple(jax.devices())
+        return self._devices
+
+    @property
+    def device_count(self) -> int:
+        return len(self.devices)
+
+    @property
+    def mesh(self):
+        """Materialize (and cache) the jax Mesh for this context."""
+        if self._mesh is None:
+            import numpy as np
+            import jax
+
+            devs = np.asarray(self.devices)
+            shape = self.mesh_shape or (len(devs),)
+            names = self.axis_names
+            if len(shape) != len(names):
+                names = tuple(f"axis{i}" for i in range(len(shape)))
+            self._mesh = jax.sharding.Mesh(devs.reshape(shape), names)
+        return self._mesh
+
+    def replace(self, **kw) -> "ComputeContext":
+        return dataclasses.replace(self, **kw)
+
+    def stop(self) -> None:
+        """Release the mesh handle (SparkContext.stop analog; jax devices
+        themselves are process-global so there is nothing else to free)."""
+        self._mesh = None
+
+
+def workflow_context(mode: str = "train", batch: str = "",
+                     mesh_shape: Optional[Tuple[int, ...]] = None,
+                     axis_names: Tuple[str, ...] = ("data",),
+                     devices: Optional[Sequence[Any]] = None
+                     ) -> ComputeContext:
+    """Factory mirroring ``WorkflowContext.apply`` (WorkflowContext.scala:26)."""
+    return ComputeContext(mode=mode, batch=batch, mesh_shape=mesh_shape,
+                          axis_names=axis_names, _devices=devices)

@@ -94,9 +94,12 @@ class DataMap(Mapping[str, Any]):
     def get(self, name: str, typ: Any = _NO_TYP, default: Any = ...) -> Any:
         """Typed get; raises DataMapError when missing unless a default is given.
 
-        Also honors ``Mapping.get`` semantics: a non-type second positional
+        Also honors ``Mapping.get``-style calls: a non-type second positional
         argument (including None) is treated as the default — ``dm.get('k', 0)``
-        returns 0 when 'k' is absent, like any Mapping.
+        returns 0 when 'k' is absent. One deliberate divergence from Mapping:
+        a field explicitly present with value None counts as ABSENT (returns
+        the default) — parity with the reference, where json4s JNull extracts
+        as missing (DataMap.scala get/getOpt).
         """
         if typ is DataMap._NO_TYP:
             typ = None

@@ -161,8 +161,11 @@ class SqliteClient:
             c._teardown()
 
     def release(self) -> None:
-        """Drop one DAO's reference; teardown when the last one is released."""
+        """Drop one DAO's reference; teardown when the last one is released.
+        Extra releases past zero are ignored (double-shutdown safety)."""
         with SqliteClient._clients_lock:
+            if self._refs <= 0:
+                return
             self._refs -= 1
             if self._refs > 0:
                 return
@@ -181,11 +184,17 @@ class SqliteClient:
                                 check_same_thread=False)
             c.execute("PRAGMA journal_mode=WAL")
             c.execute("PRAGMA synchronous=NORMAL")
-            self._local.conn = c
             thread = threading.current_thread()
             with self._conns_lock:
+                # Re-check under the lock: a concurrent _teardown() must not
+                # leave a fresh connection registered on a dead client.
+                if self._closed:
+                    c.close()
+                    raise base.StorageError(
+                        f"SqliteClient({self.path}) is shut down")
                 self._prune_dead_locked()
                 self._thread_conns[thread.ident] = (weakref.ref(thread), c)
+            self._local.conn = c
         return c
 
     def _prune_dead_locked(self) -> None:
@@ -225,6 +234,23 @@ class SqliteClient:
                 return self._shared_conn.execute(sql, tuple(args)).fetchall()
         return self.conn().execute(sql, tuple(args)).fetchall()
 
+    def query_iter(self, sql: str, args: Sequence[Any] = (),
+                   chunk: int = 4096):
+        """Streaming read for large scans. File-backed: iterate the cursor
+        directly (WAL snapshot, own connection). Shared :memory:: fetch in
+        chunks, holding the tx lock only per chunk so writers are not
+        starved for the whole scan."""
+        if self._shared_conn is None:
+            yield from self.conn().execute(sql, tuple(args))
+            return
+        with self._tx_lock:
+            cur = self._shared_conn.execute(sql, tuple(args))
+            rows = cur.fetchmany(chunk)
+        while rows:
+            yield from rows
+            with self._tx_lock:
+                rows = cur.fetchmany(chunk)
+
     def query_one(self, sql: str, args: Sequence[Any] = ()) -> Optional[tuple]:
         rows = self.query(sql, args)
         return rows[0] if rows else None
@@ -237,8 +263,8 @@ class SqliteClient:
         self._teardown()
 
     def _teardown(self) -> None:
-        self._closed = True
         with self._conns_lock:
+            self._closed = True
             conns = [c for _, c in self._thread_conns.values()]
             self._thread_conns.clear()
         if self._shared_conn is not None:
@@ -303,7 +329,10 @@ class SqliteLEvents(base.LEvents):
         self._client.close()
 
     def shutdown(self) -> None:
-        self._client.release()
+        """Release this DAO's client reference (idempotent)."""
+        if not getattr(self, "_released", False):
+            self._released = True
+            self._client.release()
 
     def insert(self, event: Event, app_id, channel_id=None) -> str:
         validate_event(event)
@@ -400,7 +429,7 @@ class SqliteLEvents(base.LEvents):
                f"ORDER BY event_time {order}")
         if limit is not None and limit >= 0:
             sql += f" LIMIT {int(limit)}"
-        for row in self._client.query(sql, args):
+        for row in self._client.query_iter(sql, args):
             yield _row_to_event(row)
 
 
@@ -422,7 +451,10 @@ class _SqliteMetaDAO:
         self._c.close()
 
     def shutdown(self) -> None:
-        self._c.release()
+        """Release this DAO's client reference (idempotent)."""
+        if not getattr(self, "_released", False):
+            self._released = True
+            self._c.release()
 
 
 class SqliteApps(_SqliteMetaDAO, base.Apps):

@@ -1,0 +1,8 @@
+"""TPU compute kernels (JAX/XLA) — the MLlib replacement.
+
+Everything here is jit-compiled, static-shaped, and mesh-shardable.
+"""
+
+from predictionio_tpu.ops.als import ALSParams, train_als, PaddedRatings
+
+__all__ = ["ALSParams", "PaddedRatings", "train_als"]

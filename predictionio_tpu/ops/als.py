@@ -1,0 +1,280 @@
+"""Alternating least squares on TPU — the north-star kernel.
+
+Capability parity with MLlib ``ALS.trainImplicit``/``ALS.train`` as invoked
+by the recommendation template
+(``examples/scala-parallel-recommendation/custom-query/src/main/scala/
+ALSAlgorithm.scala:64-71``: rank, iterations, lambda, alpha=1.0, seed).
+
+The design follows the ALX layout (PAPERS.md: "ALX: Large Scale Matrix
+Factorization on TPUs") rather than MLlib's block-partitioned shuffle:
+
+- Ratings are padded per row into dense ``[N, L]`` index/weight tables
+  (power-law raggedness handled by padding to the longest row, optionally
+  bucketed by the caller). Static shapes keep XLA on the MXU.
+- One alternating half-step solves ALL rows in a single batched program:
+  gather the fixed side's factors ``[B, L, R]``, form normal equations with
+  two einsums (never materializing ``[B, L, R, R]``), add the shared Gram
+  matrix for the implicit term, and batch-solve via Cholesky
+  (``jax.scipy.linalg.cho_solve``).
+- Multi-chip: rows are sharded over the mesh's data axis (each device
+  solves its slice); the fixed factor matrix is replicated and the shared
+  Gram matrix is computed once — XLA inserts the collectives when the
+  caller runs this under ``shard_map``/``jit`` with shardings (see
+  ``predictionio_tpu.parallel.als_sharding``).
+
+Implicit-feedback objective (Hu-Koren-Volinsky, as in MLlib): confidence
+``c = 1 + alpha * r``, preference ``p = 1`` for observed pairs; per-row
+normal equations ``(YtY + Yt (C - I) Y + lambda*I) x = Yt C p``.
+Explicit: ``(Yt_u Y_u + lambda * n_u * I) x = Yt_u r_u`` (MLlib's ALS-WR
+lambda scaling by per-row rating count).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core.base import Params
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSParams(Params):
+    """Mirror of ALSAlgorithmParams (custom-query ALSAlgorithm.scala:13-14)
+    plus the implicit/explicit switch MLlib exposes as two entry points."""
+
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    implicit_prefs: bool = True
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class PaddedRatings:
+    """One side's ragged ratings padded to ``[n_rows, max_len]``.
+
+    ``cols[i, j]`` is the column index of the j-th rating of row i (0 when
+    padded); ``weights[i, j]`` is its rating value, 0.0 on padding — a zero
+    weight makes the padded entry contribute nothing to either the implicit
+    correction or the explicit normal equations.
+    """
+
+    cols: np.ndarray      # int32 [n_rows, L]
+    weights: np.ndarray   # float32 [n_rows, L]
+    n_rows: int
+    n_cols: int
+
+    @property
+    def max_len(self) -> int:
+        return int(self.cols.shape[1])
+
+
+def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
+                n_rows: int, n_cols: int,
+                pad_multiple: int = 8,
+                max_len: Optional[int] = None) -> PaddedRatings:
+    """CSR-style host-side padding of rating triples for one solve side.
+
+    Duplicate (row, col) pairs are summed first — the template's
+    ``reduceByKey(_ + _)`` aggregation (custom-query ALSAlgorithm.scala:50).
+    ``max_len`` truncates pathological rows (keeping the HIGHEST-weight
+    ratings) to bound memory; default keeps everything.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    values = np.asarray(values, dtype=np.float32)
+    # sum duplicates via a flat key
+    key = rows * n_cols + cols
+    uniq, inv = np.unique(key, return_inverse=True)
+    summed = np.zeros(len(uniq), dtype=np.float32)
+    np.add.at(summed, inv, values)
+    rows = (uniq // n_cols).astype(np.int64)
+    cols = (uniq % n_cols).astype(np.int64)
+    values = summed
+
+    counts = np.bincount(rows, minlength=n_rows)
+    L = int(counts.max()) if len(counts) and counts.max() > 0 else 1
+    if max_len is not None and L > max_len:
+        L = int(max_len)
+    L = max(1, -(-L // pad_multiple) * pad_multiple)
+
+    order = np.lexsort((-values, rows))  # by row, heaviest first
+    rows, cols, values = rows[order], cols[order], values[order]
+    # position of each rating within its row
+    row_starts = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(np.bincount(rows, minlength=n_rows), out=row_starts[1:])
+    pos = np.arange(len(rows)) - row_starts[rows]
+    keep = pos < L
+    rows, cols, values, pos = rows[keep], cols[keep], values[keep], pos[keep]
+
+    out_cols = np.zeros((n_rows, L), dtype=np.int32)
+    out_w = np.zeros((n_rows, L), dtype=np.float32)
+    out_cols[rows, pos] = cols
+    out_w[rows, pos] = values
+    return PaddedRatings(out_cols, out_w, n_rows, n_cols)
+
+
+def transpose_ratings(pr: PaddedRatings, rows: np.ndarray, cols: np.ndarray,
+                      values: np.ndarray, pad_multiple: int = 8,
+                      max_len: Optional[int] = None) -> PaddedRatings:
+    """The other solve side: pad by column."""
+    return pad_ratings(cols, rows, values, pr.n_cols, pr.n_rows,
+                       pad_multiple, max_len)
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
+
+def _solve_side(Y, cols, weights, lam: float, alpha: float,
+                implicit: bool):
+    """One alternating half-step: given fixed factors ``Y [M, R]`` and this
+    side's padded ratings ``[B, L]``, return new factors ``[B, R]``.
+
+    jit-friendly: static shapes, two einsums + batched Cholesky; runs on
+    the MXU. Written to be shard_map-compatible: only ``cols``/``weights``
+    carry the batch dimension.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    R = Y.shape[1]
+    Yg = jnp.take(Y, cols, axis=0)            # [B, L, R] gather
+    mask = (weights > 0).astype(Y.dtype)      # padding has weight 0
+    w = weights.astype(Y.dtype)
+    # Normal equations are precision-sensitive: force full fp32 MXU passes
+    # instead of TPU's default bf16 matmul decomposition (cf. ALX §4).
+    hi = jax.lax.Precision.HIGHEST
+
+    if implicit:
+        # A_b = YtY + alpha * sum_j r_j y_j y_j^T + lam I
+        # b_b = sum_j (1 + alpha r_j) y_j          (p = 1)
+        gram = jnp.matmul(Y.T, Y, precision=hi)                  # [R, R]
+        corr = jnp.einsum("bl,blr,bls->brs", alpha * w, Yg, Yg,
+                          precision=hi)                          # [B, R, R]
+        A = gram[None, :, :] + corr
+        A += lam * jnp.eye(R, dtype=Y.dtype)[None, :, :]
+        b = jnp.einsum("bl,blr->br", mask + alpha * w, Yg,
+                       precision=hi)                             # [B, R]
+    else:
+        # explicit ALS-WR: A_b = sum_j y_j y_j^T + lam n_b I; b = sum r y
+        A = jnp.einsum("bl,blr,bls->brs", mask, Yg, Yg, precision=hi)
+        n_b = jnp.sum(mask, axis=1)                              # [B]
+        A += (lam * jnp.maximum(n_b, 1.0))[:, None, None] \
+            * jnp.eye(R, dtype=Y.dtype)[None, :, :]
+        b = jnp.einsum("bl,blr->br", w, Yg, precision=hi)
+
+    chol = jax.scipy.linalg.cho_factor(A)
+    X = jax.scipy.linalg.cho_solve(chol, b)
+    # rows with no ratings keep a zero factor (matches MLlib dropping them)
+    has_any = (jnp.sum(mask, axis=1) > 0).astype(Y.dtype)
+    return X * has_any[:, None]
+
+
+def _als_iterations_impl(X, Y, u_cols, u_w, i_cols, i_w, *, lam, alpha,
+                         implicit, num_iterations):
+    """Full training loop as one compiled program (lax.scan over
+    iterations; no data-dependent Python control flow)."""
+    import jax
+
+    def body(carry, _):
+        X, Y = carry
+        X = _solve_side(Y, u_cols, u_w, lam, alpha, implicit)
+        Y = _solve_side(X, i_cols, i_w, lam, alpha, implicit)
+        return (X, Y), None
+
+    (X, Y), _ = jax.lax.scan(body, (X, Y), None, length=num_iterations)
+    return X, Y
+
+
+_als_iterations_jit = None
+
+
+def _als_iterations(*args, **kw):
+    """Lazily-jitted wrapper (keeps jax out of storage-only imports)."""
+    global _als_iterations_jit
+    if _als_iterations_jit is None:
+        import jax
+
+        _als_iterations_jit = jax.jit(
+            _als_iterations_impl,
+            static_argnames=("lam", "alpha", "implicit", "num_iterations"))
+    return _als_iterations_jit(*args, **kw)
+
+
+def init_factors(n_rows: int, n_cols: int, rank: int,
+                 seed: Optional[int], dtype=None) -> Tuple:
+    """MLlib-style init: small random factors scaled by 1/sqrt(rank)."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.float32
+    key = jax.random.PRNGKey(0 if seed is None else int(seed))
+    ku, ki = jax.random.split(key)
+    scale = 1.0 / np.sqrt(rank)
+    X = jax.random.normal(ku, (n_rows, rank), dtype=dtype) * scale
+    Y = jax.random.normal(ki, (n_cols, rank), dtype=dtype) * scale
+    return X, Y
+
+
+def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
+              params: ALSParams, dtype=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Train and return host numpy ``(user_factors [N, R],
+    item_factors [M, R])``.
+
+    ``user_side`` is padded by user (cols are item indices); ``item_side``
+    by item (cols are user indices).
+    """
+    import jax.numpy as jnp
+
+    assert user_side.n_rows == item_side.n_cols
+    assert user_side.n_cols == item_side.n_rows
+    X, Y = init_factors(user_side.n_rows, user_side.n_cols, params.rank,
+                        params.seed, dtype)
+    u_cols = jnp.asarray(user_side.cols)
+    u_w = jnp.asarray(user_side.weights)
+    i_cols = jnp.asarray(item_side.cols)
+    i_w = jnp.asarray(item_side.weights)
+    X, Y = _als_iterations(
+        X, Y, u_cols, u_w, i_cols, i_w,
+        lam=float(params.lambda_), alpha=float(params.alpha),
+        implicit=bool(params.implicit_prefs),
+        num_iterations=int(params.num_iterations))
+    return np.asarray(X), np.asarray(Y)
+
+
+# ---------------------------------------------------------------------------
+# Scoring / prediction helpers
+# ---------------------------------------------------------------------------
+
+def top_k_items(scores: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side top-k (indices, scores) descending."""
+    k = min(k, scores.shape[-1])
+    idx = np.argpartition(-scores, k - 1, axis=-1)[..., :k]
+    top = np.take_along_axis(scores, idx, axis=-1)
+    order = np.argsort(-top, axis=-1)
+    return np.take_along_axis(idx, order, axis=-1), \
+        np.take_along_axis(top, order, axis=-1)
+
+
+def cosine_scores(query_features: np.ndarray,
+                  item_factors: np.ndarray) -> np.ndarray:
+    """Summed cosine similarity of each item against every query feature
+    row — the template's predict scoring (custom-query
+    ALSAlgorithm.scala:77-103, cosine at :121-135)."""
+    q = np.atleast_2d(query_features)
+    qn = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+    inorm = np.maximum(np.linalg.norm(item_factors, axis=1, keepdims=True),
+                       1e-12)
+    yn = item_factors / inorm
+    return (yn @ qn.T).sum(axis=1)
+
+
+def predict_scores_for_user(user_factor: np.ndarray,
+                            item_factors: np.ndarray) -> np.ndarray:
+    """Dot-product recommendation scores for one user (MLlib
+    recommendProducts semantics)."""
+    return item_factors @ user_factor

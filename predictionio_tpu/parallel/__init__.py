@@ -1,0 +1,13 @@
+"""Distributed execution: mesh construction + sharded training steps.
+
+The reference's distribution story is Spark RDD partitioning plus MLlib's
+block-partitioned ALS shuffles (SURVEY §2.6). The TPU-native answer is a
+``jax.sharding.Mesh`` with GSPMD sharding propagation: we annotate input
+shardings; XLA inserts the all-gathers/psums over ICI. No NCCL/MPI analog
+is needed — collectives are compiled into the program.
+"""
+
+from predictionio_tpu.parallel.mesh import data_parallel_mesh
+from predictionio_tpu.parallel.als_sharding import train_als_sharded
+
+__all__ = ["data_parallel_mesh", "train_als_sharded"]

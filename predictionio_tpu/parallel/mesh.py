@@ -1,0 +1,39 @@
+"""Mesh construction helpers.
+
+Replaces the reference's Spark-cluster topology (executors over netty,
+SURVEY §2.6 comm-backend row) with explicit jax device meshes. Axis
+convention: ``data`` shards batch/rows, ``model`` shards factor/feature
+dimensions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+
+def data_parallel_mesh(n_devices: Optional[int] = None,
+                       devices: Optional[Sequence] = None):
+    """1-D mesh over the first ``n_devices`` devices, axis name 'data'."""
+    import numpy as np
+    import jax
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return jax.sharding.Mesh(np.asarray(devs), ("data",))
+
+
+def mesh_2d(data: int, model: int, devices: Optional[Sequence] = None):
+    """2-D (data × model) mesh for model-parallel factor sharding."""
+    import numpy as np
+    import jax
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    need = data * model
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    return jax.sharding.Mesh(
+        np.asarray(devs[:need]).reshape(data, model), ("data", "model"))

@@ -1,0 +1,1 @@
+"""Engine templates — the user-land workload surface (SURVEY §2.5)."""

@@ -1,0 +1,30 @@
+"""Recommendation template (ALS) — parity with
+``examples/scala-parallel-recommendation`` (SURVEY §2.5 row 1)."""
+
+from predictionio_tpu.templates.recommendation.engine import (
+    ALSAlgorithm,
+    ALSModel,
+    DataSourceParams,
+    EventDataSource,
+    ItemScore,
+    PredictedResult,
+    Query,
+    RatingsPreparator,
+    RecommendationServing,
+    TrainingData,
+    engine_factory,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "ALSModel",
+    "DataSourceParams",
+    "EventDataSource",
+    "ItemScore",
+    "PredictedResult",
+    "Query",
+    "RatingsPreparator",
+    "RecommendationServing",
+    "TrainingData",
+    "engine_factory",
+]

@@ -1,0 +1,274 @@
+"""Recommendation engine: rate events -> implicit ALS -> top-N items.
+
+Capability parity with ``examples/scala-parallel-recommendation`` (the
+driver's north-star workload, BASELINE.md):
+
+- DataSource reads ``rate``/``view`` events via PEventStore
+  (``custom-query/src/main/scala/DataSource.scala:31-65``)
+- Preparator indexes entity IDs with BiMap and pads ratings into the
+  TPU layout (``Preparator.scala`` + BiMap.scala:63-129)
+- ALSAlgorithm trains implicit ALS on the mesh
+  (``ALSAlgorithm.scala:64-103``: rank/iters/lambda/seed, alpha=1.0)
+- predict: per-user dot-product top-N with optional seen-item blacklist;
+  item-similarity cosine scoring available for item queries
+- Serving returns the first algorithm's result
+
+The model is a P2L product: factors come back to host numpy and pickle
+cleanly into the Models repository (persistence mode 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Engine,
+    LFirstServing,
+    LServing,
+    P2LAlgorithm,
+    Params,
+    PDataSource,
+    PPreparator,
+)
+from predictionio_tpu.core.context import ComputeContext
+from predictionio_tpu.data.bimap import BiMap, StringIndexBiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.als import (
+    ALSParams,
+    PaddedRatings,
+    cosine_scores,
+    pad_ratings,
+    predict_scores_for_user,
+    top_k_items,
+    train_als,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str
+    event_names: Tuple[str, ...] = ("rate",)
+    channel_name: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Rating:
+    user: str
+    item: str
+    rating: float
+
+
+@dataclasses.dataclass
+class TrainingData:
+    ratings: List[Rating]
+
+    def sanity_check(self) -> None:
+        assert self.ratings, (
+            "ratings in TrainingData cannot be empty. Please check if "
+            "DataSource generates TrainingData correctly.")
+
+
+class EventDataSource(PDataSource):
+    """Reads rating events (DataSource.scala:31-65): rate -> property
+    'rating', view -> implicit count of 1."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        p: DataSourceParams = self.params
+        events = PEventStore.find(
+            app_name=p.app_name,
+            channel_name=p.channel_name,
+            entity_type="user",
+            event_names=list(p.event_names),
+            target_entity_type="item",
+        )
+        ratings = []
+        for e in events:
+            rating = e.properties.get("rating", float, 1.0)
+            ratings.append(Rating(e.entity_id, e.target_entity_id, rating))
+        return TrainingData(ratings)
+
+    def read_eval(self, ctx: ComputeContext):
+        """k-fold style eval: hold out every k-th rating per user as the
+        actual; query asks for top-N (readEval analog in the template's
+        evaluation variant)."""
+        td = self.read_training(ctx)
+        by_user: Dict[str, List[Rating]] = {}
+        for r in td.ratings:
+            by_user.setdefault(r.user, []).append(r)
+        train: List[Rating] = []
+        qa: List[Tuple[Query, Any]] = []
+        for user, rs in by_user.items():
+            if len(rs) < 2:
+                train.extend(rs)
+                continue
+            held = rs[-1]
+            train.extend(rs[:-1])
+            qa.append((Query(user=user, num=10), ActualResult([held.item])))
+        return [(TrainingData(train), EmptyEvalInfo(), qa)]
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyEvalInfo:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    """Top-N query: by user (personal recs) or by items (similarity)."""
+
+    user: Optional[str] = None
+    items: Tuple[str, ...] = ()
+    num: int = 10
+    blacklist: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ActualResult:
+    items: Tuple[str, ...]
+
+    def __init__(self, items: Sequence[str]):
+        object.__setattr__(self, "items", tuple(items))
+
+
+@dataclasses.dataclass
+class PreparedData:
+    """BiMap-indexed, TPU-padded ratings."""
+
+    user_map: StringIndexBiMap
+    item_map: StringIndexBiMap
+    user_side: PaddedRatings
+    item_side: PaddedRatings
+    seen: Dict[int, np.ndarray]  # user idx -> item idx array (for blacklist)
+
+    def sanity_check(self) -> None:
+        assert self.user_side.n_rows > 0, "no users after indexing"
+        assert self.user_side.n_cols > 0, "no items after indexing"
+
+
+class RatingsPreparator(PPreparator):
+    """BiMap.stringInt indexing + ALX padding (the reference does the BiMap
+    step inside ALSAlgorithm.train, ALSAlgorithm.scala:35-36; here it is a
+    proper Preparator so multiple algorithms share the layout)."""
+
+    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
+        user_map = BiMap.string_int(r.user for r in td.ratings)
+        item_map = BiMap.string_int(r.item for r in td.ratings)
+        n_u, n_i = len(user_map), len(item_map)
+        rows = np.fromiter((user_map[r.user] for r in td.ratings),
+                           dtype=np.int64, count=len(td.ratings))
+        cols = np.fromiter((item_map[r.item] for r in td.ratings),
+                           dtype=np.int64, count=len(td.ratings))
+        vals = np.fromiter((r.rating for r in td.ratings),
+                           dtype=np.float32, count=len(td.ratings))
+        user_side = pad_ratings(rows, cols, vals, n_u, n_i)
+        item_side = pad_ratings(cols, rows, vals, n_i, n_u)
+        seen = {u: cols[rows == u].astype(np.int64)
+                for u in range(n_u)}
+        return PreparedData(user_map, item_map, user_side, item_side, seen)
+
+
+@dataclasses.dataclass
+class ALSModel:
+    """Host-resident factors + maps (ALSModel.scala analog; automatic
+    persistence — pickles into the Models repo)."""
+
+    user_factors: np.ndarray     # [N, R]
+    item_factors: np.ndarray     # [M, R]
+    user_map: StringIndexBiMap
+    item_map: StringIndexBiMap
+    seen: Dict[int, np.ndarray]
+
+    def sanity_check(self) -> None:
+        assert np.isfinite(self.user_factors).all(), "non-finite user factors"
+        assert np.isfinite(self.item_factors).all(), "non-finite item factors"
+
+
+class ALSAlgorithm(P2LAlgorithm):
+    """Implicit ALS on the TPU mesh (ALSAlgorithm.scala:64-103 parity)."""
+
+    params_class = ALSParams
+    query_cls = Query
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> ALSModel:
+        X, Y = train_als(pd.user_side, pd.item_side, self.params)
+        return ALSModel(X, Y, pd.user_map, pd.item_map, pd.seen)
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        if isinstance(query, dict):  # raw JSON query from the server
+            query = Query(user=query.get("user"),
+                          items=tuple(query.get("items", ())),
+                          num=int(query.get("num", 10)),
+                          blacklist=tuple(query.get("blacklist", ())))
+        if query.items:
+            scores = self._item_similarity_scores(model, query)
+        elif query.user is not None:
+            scores = self._user_scores(model, query)
+        else:
+            return PredictedResult(())
+        if scores is None:
+            return PredictedResult(())
+        # blacklist + seen filtering
+        black = [model.item_map[i] for i in query.blacklist
+                 if i in model.item_map]
+        if black:
+            scores[np.asarray(black, dtype=np.int64)] = -np.inf
+        idx, top = top_k_items(scores, query.num)
+        keep = np.isfinite(top) & (top > 0)
+        items = model.item_map.decode(idx[keep])
+        return PredictedResult(tuple(
+            ItemScore(item=i, score=float(s))
+            for i, s in zip(items, top[keep])))
+
+    def _user_scores(self, model: ALSModel,
+                     query: Query) -> Optional[np.ndarray]:
+        uidx = model.user_map.get(query.user)
+        if uidx is None:
+            return None
+        scores = predict_scores_for_user(
+            model.user_factors[uidx], model.item_factors)
+        seen = model.seen.get(uidx)
+        if seen is not None and len(seen):
+            scores = scores.copy()
+            scores[seen] = -np.inf  # never recommend already-rated items
+        return scores
+
+    def _item_similarity_scores(self, model: ALSModel,
+                                query: Query) -> Optional[np.ndarray]:
+        idxs = [model.item_map[i] for i in query.items
+                if i in model.item_map]
+        if not idxs:
+            return None
+        qf = model.item_factors[np.asarray(idxs, dtype=np.int64)]
+        scores = cosine_scores(qf, model.item_factors)
+        scores[np.asarray(idxs, dtype=np.int64)] = -np.inf  # not the query
+        return scores
+
+
+class RecommendationServing(LFirstServing):
+    """First-serving (template Serving.scala returns the single result)."""
+
+
+def engine_factory() -> Engine:
+    """EngineFactory analog (custom-query Engine.scala:13-19)."""
+    return Engine(
+        EventDataSource,
+        RatingsPreparator,
+        {"als": ALSAlgorithm, "": ALSAlgorithm},
+        RecommendationServing,
+    )

@@ -1,0 +1,1 @@
+"""Operator tooling: the ``pio`` CLI and servers (SURVEY §2.3)."""

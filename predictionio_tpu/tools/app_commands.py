@@ -1,0 +1,138 @@
+"""``pio app`` subcommands: new/list/show/delete/data-delete.
+
+Parity: ``tools/.../console/App.scala`` — creates the app with a default
+access key, lists with keys, data-delete wipes one channel or the whole
+event store for the app.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.storage.base import AccessKey, App
+
+
+def dispatch(args) -> int:
+    cmd = getattr(args, "app_command", None)
+    if cmd == "new":
+        return app_new(args.name, args.description, args.access_key)
+    if cmd == "list":
+        return app_list()
+    if cmd == "show":
+        return app_show(args.name)
+    if cmd == "delete":
+        return app_delete(args.name, args.force)
+    if cmd == "data-delete":
+        return app_data_delete(args.name, args.channel, args.force)
+    print("usage: pio app {new,list,show,delete,data-delete} ...",
+          file=sys.stderr)
+    return 2
+
+
+def app_new(name: str, description=None, access_key=None) -> int:
+    apps = storage.get_metadata_apps()
+    if apps.get_by_name(name) is not None:
+        print(f"[ERROR] App {name} already exists. Aborting.",
+              file=sys.stderr)
+        return 1
+    app_id = apps.insert(App(0, name, description))
+    if app_id is None:
+        print(f"[ERROR] Unable to create app {name}.", file=sys.stderr)
+        return 1
+    storage.get_levents().init(app_id)
+    key = storage.get_metadata_access_keys().insert(
+        AccessKey(access_key or "", app_id, ()))
+    print("[INFO] Created a new app:")
+    print(f"[INFO]         Name: {name}")
+    print(f"[INFO]           ID: {app_id}")
+    print(f"[INFO]   Access Key: {key}")
+    return 0
+
+
+def app_list() -> int:
+    apps = sorted(storage.get_metadata_apps().get_all(), key=lambda a: a.name)
+    keys = storage.get_metadata_access_keys()
+    print(f"[INFO] {'Name':<20} | {'ID':>4} | Access Key")
+    for a in apps:
+        aks = keys.get_by_appid(a.id)
+        first = aks[0].key if aks else ""
+        print(f"[INFO] {a.name:<20} | {a.id:>4} | {first}")
+    print(f"[INFO] Finished listing {len(apps)} app(s).")
+    return 0
+
+
+def app_show(name: str) -> int:
+    app = storage.get_metadata_apps().get_by_name(name)
+    if app is None:
+        print(f"[ERROR] App {name} does not exist. Aborting.",
+              file=sys.stderr)
+        return 1
+    print(f"[INFO]       App Name: {app.name}")
+    print(f"[INFO]         App ID: {app.id}")
+    print(f"[INFO]    Description: {app.description or ''}")
+    for k in storage.get_metadata_access_keys().get_by_appid(app.id):
+        events = ",".join(k.events) if k.events else "(all)"
+        print(f"[INFO]     Access Key: {k.key} | {events}")
+    for c in storage.get_metadata_channels().get_by_appid(app.id):
+        print(f"[INFO]        Channel: {c.name} ({c.id})")
+    return 0
+
+
+def app_delete(name: str, force: bool = False) -> int:
+    apps = storage.get_metadata_apps()
+    app = apps.get_by_name(name)
+    if app is None:
+        print(f"[ERROR] App {name} does not exist. Aborting.",
+              file=sys.stderr)
+        return 1
+    if not force and not _confirm(f"Delete app {name} and ALL its data?"):
+        print("[INFO] Aborted.")
+        return 0
+    channels = storage.get_metadata_channels()
+    levents = storage.get_levents()
+    for c in channels.get_by_appid(app.id):
+        levents.remove(app.id, c.id)
+        channels.delete(c.id)
+    levents.remove(app.id)
+    keys = storage.get_metadata_access_keys()
+    for k in keys.get_by_appid(app.id):
+        keys.delete(k.key)
+    apps.delete(app.id)
+    print(f"[INFO] App successfully deleted: {name}")
+    return 0
+
+
+def app_data_delete(name: str, channel=None, force: bool = False) -> int:
+    apps = storage.get_metadata_apps()
+    app = apps.get_by_name(name)
+    if app is None:
+        print(f"[ERROR] App {name} does not exist. Aborting.",
+              file=sys.stderr)
+        return 1
+    channel_id = None
+    if channel is not None:
+        match = next((c for c in storage.get_metadata_channels()
+                      .get_by_appid(app.id) if c.name == channel), None)
+        if match is None:
+            print(f"[ERROR] Channel {channel} does not exist. Aborting.",
+                  file=sys.stderr)
+            return 1
+        channel_id = match.id
+    if not force and not _confirm(
+            f"Delete all event data of app {name}"
+            + (f" channel {channel}" if channel else "") + "?"):
+        print("[INFO] Aborted.")
+        return 0
+    levents = storage.get_levents()
+    levents.remove(app.id, channel_id)
+    levents.init(app.id, channel_id)  # wipe + reinit (App.scala data-delete)
+    print(f"[INFO] Removed event data of app: {name}")
+    return 0
+
+
+def _confirm(prompt: str) -> bool:
+    try:
+        return input(f"{prompt} (y/N) ").strip().lower() == "y"
+    except EOFError:
+        return False

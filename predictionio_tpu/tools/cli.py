@@ -1,0 +1,91 @@
+"""``pio`` console — operator CLI.
+
+Parity target: ``tools/.../console/Console.scala:133-769`` (~30 verbs).
+This module grows verb-by-verb; currently: status, version, app.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from predictionio_tpu import __version__
+
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def cmd_status(args) -> int:
+    """Verify storage wiring (Console status -> Storage.verifyAllDataObjects,
+    Storage.scala:335-358)."""
+    from predictionio_tpu.data import storage
+    from predictionio_tpu.data.storage.base import StorageError
+
+    try:
+        cfg = storage.registry().config
+        print("[INFO] Storage sources:")
+        for name, src in cfg.sources.items():
+            shown = {k: v for k, v in src.items()}
+            print(f"[INFO]   {name}: {shown}")
+        print("[INFO] Repository bindings:")
+        for repo, src in cfg.repositories.items():
+            print(f"[INFO]   {repo} -> {src}")
+        storage.verify_all_data_objects()
+    except StorageError as e:
+        print(f"[ERROR] Storage check failed: {e}", file=sys.stderr)
+        return 1
+    print("[INFO] Your system is all ready to go.")
+    return 0
+
+
+def cmd_app(args) -> int:
+    from predictionio_tpu.tools import app_commands
+
+    return app_commands.dispatch(args)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pio",
+        description="predictionio-tpu console (reference: pio CLI)")
+    sub = parser.add_subparsers(dest="command")
+
+    sub.add_parser("version", help="print version").set_defaults(
+        func=cmd_version)
+    sub.add_parser("status", help="verify storage configuration").set_defaults(
+        func=cmd_status)
+
+    app = sub.add_parser("app", help="manage apps")
+    app_sub = app.add_subparsers(dest="app_command")
+    new = app_sub.add_parser("new", help="create an app")
+    new.add_argument("name")
+    new.add_argument("--description", default=None)
+    new.add_argument("--access-key", default=None)
+    app_sub.add_parser("list", help="list apps")
+    show = app_sub.add_parser("show", help="show an app")
+    show.add_argument("name")
+    delete = app_sub.add_parser("delete", help="delete an app")
+    delete.add_argument("name")
+    delete.add_argument("-f", "--force", action="store_true")
+    dd = app_sub.add_parser("data-delete", help="delete an app's event data")
+    dd.add_argument("name")
+    dd.add_argument("--channel", default=None)
+    dd.add_argument("-f", "--force", action="store_true")
+    app.set_defaults(func=cmd_app)
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "func", None):
+        parser.print_help()
+        return 2
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,28 @@
+"""Workflow runtime: train/eval entries around the DASE engine.
+
+Parity: reference ``core/src/main/scala/io/prediction/workflow/``
+(CoreWorkflow, CreateWorkflow, EvaluationWorkflow). There is no
+spark-submit process boundary — the runner IS the TPU host process.
+"""
+
+from predictionio_tpu.workflow.core_workflow import (
+    load_engine_factory,
+    run_evaluation,
+    run_train,
+    serialize_models,
+    deserialize_models,
+)
+from predictionio_tpu.workflow.create_workflow import (
+    WorkflowConfig,
+    create_workflow,
+)
+
+__all__ = [
+    "WorkflowConfig",
+    "create_workflow",
+    "deserialize_models",
+    "load_engine_factory",
+    "run_evaluation",
+    "run_train",
+    "serialize_models",
+]

@@ -1,0 +1,148 @@
+"""CoreWorkflow — run train/eval with metadata + model persistence.
+
+Parity: ``core/.../workflow/CoreWorkflow.scala:42-99`` (runTrain: train ->
+serialize models -> Models repo -> EngineInstance COMPLETED) and
+``:101-160`` (runEvaluation: EvaluationInstance INIT -> EVALCOMPLETED with
+rendered results). Kryo is replaced by pickle (model blobs are opaque bytes
+in the Models DAO either way); SparkContext by ComputeContext.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import importlib
+import logging
+import pickle
+from typing import Any, Callable, List, Optional, Sequence
+
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.core.base import (
+    BaseEvaluator,
+    BaseEvaluatorResult,
+    TrainingInterruption,
+    WorkflowParams,
+)
+from predictionio_tpu.core.context import ComputeContext, workflow_context
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.storage.base import (
+    EngineInstance, EvaluationInstance, Model,
+)
+
+logger = logging.getLogger("predictionio_tpu.workflow")
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(tz=_dt.timezone.utc)
+
+
+def serialize_models(models: Sequence[Any]) -> bytes:
+    """Persistable models -> blob (KryoInstantiator analog,
+    CoreWorkflow.scala:74-79)."""
+    return pickle.dumps(list(models), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_models(blob: bytes) -> List[Any]:
+    return pickle.loads(blob)
+
+
+def load_engine_factory(path: str) -> Callable[[], Engine]:
+    """Resolve an engine factory from ``module:callable``
+    (WorkflowUtils.getEngine reflection analog, WorkflowUtils.scala:62-79)."""
+    mod_name, _, attr = path.partition(":")
+    if not attr:
+        raise ValueError(
+            f"engine factory must be 'module:callable', got {path!r}")
+    obj: Any = importlib.import_module(mod_name)
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    if not callable(obj):
+        raise TypeError(f"{path} is not callable")
+    return obj
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    engine_instance: EngineInstance,
+    params: Optional[WorkflowParams] = None,
+    ctx: Optional[ComputeContext] = None,
+) -> Optional[str]:
+    """Train, persist models, and mark the instance COMPLETED.
+
+    Returns the engine-instance id on success, None when interrupted by a
+    stop-after debug flag (CoreWorkflow.scala:87-92 swallows those). Any
+    other failure marks the instance FAILED and re-raises.
+    """
+    params = params or WorkflowParams()
+    batch = params.batch or engine_instance.batch
+    ctx = ctx or workflow_context(mode="train", batch=batch)
+
+    engine_instances = storage.get_metadata_engine_instances()
+    instance_id = engine_instances.insert(engine_instance)
+    instance = engine_instances.get(instance_id)
+    assert instance is not None
+
+    try:
+        models = engine.train(
+            ctx, engine_params, engine_instance_id=instance_id, params=params)
+
+        logger.info("Inserting persistent model")
+        storage.get_model_data_models().insert(
+            Model(id=instance_id, models=serialize_models(models)))
+
+        logger.info("Updating engine instance")
+        engine_instances.update(dataclasses.replace(
+            instance, status="COMPLETED", end_time=_now()))
+        logger.info("Training completed successfully.")
+        return instance_id
+    except TrainingInterruption as e:
+        logger.info("Training interrupted by %r.", e)
+        return None
+    except Exception:
+        engine_instances.update(dataclasses.replace(
+            instance, status="FAILED", end_time=_now()))
+        raise
+    finally:
+        ctx.stop()
+
+
+def run_evaluation(
+    engine: Engine,
+    engine_params_list: Sequence[EngineParams],
+    evaluation_instance: EvaluationInstance,
+    evaluator: BaseEvaluator,
+    evaluation: Any = None,
+    params: Optional[WorkflowParams] = None,
+    ctx: Optional[ComputeContext] = None,
+) -> BaseEvaluatorResult:
+    """batch_eval over all params sets, score with the evaluator, record the
+    EvaluationInstance (CoreWorkflow.scala:101-160 +
+    EvaluationWorkflow.scala:31-41)."""
+    params = params or WorkflowParams()
+    ctx = ctx or workflow_context(mode="eval", batch=params.batch)
+
+    evaluation_instances = storage.get_metadata_evaluation_instances()
+    instance_id = evaluation_instances.insert(evaluation_instance)
+    logger.info("Starting evaluation instance ID: %s", instance_id)
+    instance = evaluation_instances.get(instance_id)
+    assert instance is not None
+
+    try:
+        eval_data = engine.batch_eval(ctx, list(engine_params_list), params)
+        result = evaluator.evaluate_base(ctx, evaluation, eval_data, params)
+
+        if result.no_save:
+            logger.info("Result not inserted into database: %r", result)
+        else:
+            evaluation_instances.update(dataclasses.replace(
+                instance,
+                status="EVALCOMPLETED",
+                end_time=_now(),
+                evaluator_results=result.to_one_liner(),
+                evaluator_results_html=result.to_html(),
+                evaluator_results_json=result.to_json(),
+            ))
+        return result
+    finally:
+        ctx.stop()

@@ -1,0 +1,113 @@
+"""CreateWorkflow — the train/eval entry point.
+
+Parity: ``core/.../workflow/CreateWorkflow.scala:40-273`` — resolve the
+engine factory, parse the variant file into EngineParams, record an
+EngineInstance with the full params snapshot, dispatch to CoreWorkflow.
+The spark-submit process boundary is gone: this runs in the TPU host
+process (SURVEY §7 design stance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import os
+from typing import Any, Dict, Mapping, Optional
+
+from predictionio_tpu.controller.engine import (
+    Engine, EngineParams, params_to_dict,
+)
+from predictionio_tpu.core.base import WorkflowParams
+from predictionio_tpu.data.storage.base import EngineInstance
+from predictionio_tpu.workflow import core_workflow
+
+
+@dataclasses.dataclass
+class WorkflowConfig:
+    """CLI-facing workflow configuration (CreateWorkflow.scala:40-58)."""
+
+    engine_id: str = "default"
+    engine_version: str = "default"
+    engine_variant: str = "engine.json"
+    engine_factory: str = ""
+    batch: str = ""
+    verbose: int = 2
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+
+    def workflow_params(self) -> WorkflowParams:
+        return WorkflowParams(
+            batch=self.batch,
+            verbose=self.verbose,
+            skip_sanity_check=self.skip_sanity_check,
+            stop_after_read=self.stop_after_read,
+            stop_after_prepare=self.stop_after_prepare,
+        )
+
+
+def pio_env_vars() -> Dict[str, str]:
+    """Snapshot of PIO_* env (WorkflowUtils.pioEnvVars,
+    WorkflowUtils.scala:205)."""
+    return {k: v for k, v in os.environ.items() if k.startswith("PIO_")}
+
+
+def _params_snapshot(engine_params: EngineParams) -> Dict[str, str]:
+    """JSON snapshots of every stage's params for the EngineInstance record
+    (CreateWorkflow.scala:223-245)."""
+    def one(pair):
+        name, params = pair
+        return json.dumps({"name": name, "params": params_to_dict(params)})
+
+    return {
+        "data_source_params": one(engine_params.data_source_params),
+        "preparator_params": one(engine_params.preparator_params),
+        "algorithms_params": json.dumps([
+            {"name": n, "params": params_to_dict(p)}
+            for n, p in engine_params.algorithm_params_list]),
+        "serving_params": one(engine_params.serving_params),
+    }
+
+
+def new_engine_instance(config: WorkflowConfig,
+                        engine_params: EngineParams) -> EngineInstance:
+    now = _dt.datetime.now(tz=_dt.timezone.utc)
+    snap = _params_snapshot(engine_params)
+    return EngineInstance(
+        id="",
+        status="INIT",
+        start_time=now,
+        end_time=now,
+        engine_id=config.engine_id,
+        engine_version=config.engine_version,
+        engine_variant=config.engine_variant,
+        engine_factory=config.engine_factory,
+        batch=config.batch,
+        env=pio_env_vars(),
+        **snap,
+    )
+
+
+def create_workflow(
+    config: WorkflowConfig,
+    variant: Optional[Mapping[str, Any]] = None,
+    engine: Optional[Engine] = None,
+) -> Optional[str]:
+    """Resolve engine + params and run training; returns the engine-instance
+    id (None when interrupted by a stop-after flag).
+
+    ``engine`` short-circuits factory resolution (tests); otherwise
+    ``config.engine_factory`` ("module:callable") is loaded. ``variant``
+    short-circuits reading ``config.engine_variant`` as a JSON file.
+    """
+    if engine is None:
+        factory = core_workflow.load_engine_factory(config.engine_factory)
+        engine = factory()
+    if variant is None:
+        with open(config.engine_variant, "r", encoding="utf-8") as f:
+            variant = json.load(f)
+    engine_params = engine.engine_params_from_variant(variant)
+    instance = new_engine_instance(config, engine_params)
+    return core_workflow.run_train(
+        engine, engine_params, instance, params=config.workflow_params())

@@ -14,6 +14,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# A sitecustomize (e.g. the axon TPU tunnel) may force JAX_PLATFORMS back to
+# a real accelerator after env setup; the config update after import wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 from predictionio_tpu.data import storage  # noqa: E402

@@ -1,0 +1,239 @@
+"""Stub DASE components whose outputs encode their identity and params.
+
+Mirrors the reference fixture strategy (``core/src/test/scala/io/prediction/
+controller/SampleEngine.scala:12+``): every stage stamps its id into its
+output so tests can assert exact pipeline wiring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.controller import (
+    LAlgorithm,
+    LServing,
+    P2LAlgorithm,
+    PAlgorithm,
+    Params,
+    PDataSource,
+    PersistentModel,
+    PPreparator,
+)
+
+
+@dataclasses.dataclass
+class TrainingData:
+    id: int
+    error: bool = False
+
+    def sanity_check(self) -> None:
+        assert not self.error, "Not Error"
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalInfo:
+    id: int
+
+
+@dataclasses.dataclass
+class ProcessedData:
+    id: int
+    td: TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    id: int
+    ex: int = 0
+    qx: int = 0
+    supp: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Actual:
+    id: int
+    ex: int = 0
+    qx: int = 0
+
+
+@dataclasses.dataclass
+class Prediction:
+    id: int
+    q: Query
+    model: Any = None
+    ps: Tuple["Prediction", ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class IdParams(Params):
+    id: int
+    en: int = 0
+    qn: int = 0
+
+
+class DataSource0(PDataSource):
+    """read_training -> TrainingData(id); eval sets of en×qn queries."""
+
+    params_class = IdParams
+
+    def __init__(self, params: Optional[IdParams] = None):
+        super().__init__(params or IdParams(0))
+
+    @property
+    def id(self) -> int:
+        return self.params.id
+
+    def read_training(self, ctx) -> TrainingData:
+        return TrainingData(self.id)
+
+    def read_eval(self, ctx):
+        return [
+            (TrainingData(self.id), EvalInfo(self.id),
+             [(Query(self.id, ex=ex, qx=qx), Actual(self.id, ex, qx))
+              for qx in range(self.params.qn)])
+            for ex in range(self.params.en)
+        ]
+
+
+class FailingDataSource(PDataSource):
+    """TrainingData that fails sanity_check (SampleEngine PDataSource3)."""
+
+    params_class = IdParams
+
+    def __init__(self, params: Optional[IdParams] = None):
+        super().__init__(params or IdParams(0))
+
+    def read_training(self, ctx) -> TrainingData:
+        return TrainingData(self.params.id, error=True)
+
+
+class Preparator0(PPreparator):
+    params_class = IdParams
+
+    def __init__(self, params: Optional[IdParams] = None):
+        super().__init__(params or IdParams(0))
+
+    def prepare(self, ctx, td: TrainingData) -> ProcessedData:
+        return ProcessedData(self.params.id, td)
+
+
+@dataclasses.dataclass
+class AlgoModel:
+    id: int
+    pd: ProcessedData
+
+    def sanity_check(self) -> None:
+        pass
+
+
+class PAlgo0(PAlgorithm):
+    """Parallel algorithm stub; batch_predict stamps ids."""
+
+    params_class = IdParams
+
+    def __init__(self, params: Optional[IdParams] = None):
+        super().__init__(params or IdParams(0))
+
+    def train(self, ctx, pd: ProcessedData) -> AlgoModel:
+        return AlgoModel(self.params.id, pd)
+
+    def batch_predict(self, ctx, model, indexed_queries):
+        return [(qx, Prediction(self.params.id, q, model=model))
+                for qx, q in indexed_queries]
+
+    def predict(self, model, query) -> Prediction:
+        return Prediction(self.params.id, query, model=model)
+
+
+class P2LAlgo0(P2LAlgorithm):
+    params_class = IdParams
+
+    def __init__(self, params: Optional[IdParams] = None):
+        super().__init__(params or IdParams(0))
+
+    def train(self, ctx, pd: ProcessedData) -> AlgoModel:
+        return AlgoModel(self.params.id, pd)
+
+    def predict(self, model, query) -> Prediction:
+        return Prediction(self.params.id, query, model=model)
+
+
+class LAlgo0(LAlgorithm):
+    params_class = IdParams
+
+    def __init__(self, params: Optional[IdParams] = None):
+        super().__init__(params or IdParams(0))
+
+    def train(self, pd: ProcessedData) -> AlgoModel:
+        return AlgoModel(self.params.id, pd)
+
+    def predict(self, model, query) -> Prediction:
+        return Prediction(self.params.id, query, model=model)
+
+
+@dataclasses.dataclass
+class PersistedModel(PersistentModel):
+    """In-memory PersistentModel with a class-level store standing in for
+    external storage (PersistentModel.scala:64-100)."""
+
+    id: int
+    store = {}  # type: dict
+
+    def save(self, model_id, params, ctx=None) -> bool:
+        PersistedModel.store[model_id] = self
+        return True
+
+    @classmethod
+    def load(cls, model_id, params, ctx=None) -> "PersistedModel":
+        return cls.store[model_id]
+
+
+@dataclasses.dataclass
+class UnsavablePersistedModel(PersistentModel):
+    """save() declines -> RETRAIN path."""
+
+    id: int
+
+    def save(self, model_id, params, ctx=None) -> bool:
+        return False
+
+    @classmethod
+    def load(cls, model_id, params, ctx=None):  # pragma: no cover
+        raise AssertionError("never persisted")
+
+
+class PersistentAlgo(P2LAlgorithm):
+    """Trains a PersistedModel (custom persistence mode)."""
+
+    params_class = IdParams
+
+    def __init__(self, params: Optional[IdParams] = None):
+        super().__init__(params or IdParams(0))
+
+    def train(self, ctx, pd) -> PersistedModel:
+        return PersistedModel(self.params.id)
+
+    def predict(self, model, query) -> Prediction:
+        return Prediction(self.params.id, query, model=model)
+
+
+class Serving0(LServing):
+    """serve -> first prediction with all ps recorded."""
+
+    params_class = IdParams
+
+    def __init__(self, params: Optional[IdParams] = None):
+        super().__init__(params or IdParams(0))
+
+    def serve(self, query: Query, predictions: Sequence[Prediction]):
+        return dataclasses.replace(
+            predictions[0], ps=tuple(predictions))
+
+
+class SupplementingServing(Serving0):
+    """Marks queries as supplemented so tests can see which query reached
+    predict vs serve (LServing.scala supplement contract)."""
+
+    def supplement(self, query: Query) -> Query:
+        return dataclasses.replace(query, supp=True)

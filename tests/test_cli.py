@@ -1,0 +1,50 @@
+"""CLI tests: pio status / app verbs (Console.scala parity, growing)."""
+
+import pytest
+
+from predictionio_tpu.data import storage
+from predictionio_tpu.tools.cli import main
+
+
+class TestCli:
+    def test_version(self, capsys):
+        assert main(["version"]) == 0
+        from predictionio_tpu import __version__
+        assert capsys.readouterr().out.strip() == __version__
+
+    def test_status(self, mem_storage, capsys):
+        assert main(["status"]) == 0
+        out = capsys.readouterr().out
+        assert "ready to go" in out
+
+    def test_app_lifecycle(self, mem_storage, capsys):
+        assert main(["app", "new", "myapp", "--description", "d"]) == 0
+        out = capsys.readouterr().out
+        assert "Access Key:" in out
+        app = storage.get_metadata_apps().get_by_name("myapp")
+        assert app is not None
+        keys = storage.get_metadata_access_keys().get_by_appid(app.id)
+        assert len(keys) == 1
+
+        assert main(["app", "new", "myapp"]) == 1  # duplicate
+
+        assert main(["app", "list"]) == 0
+        assert "myapp" in capsys.readouterr().out
+
+        assert main(["app", "show", "myapp"]) == 0
+        assert main(["app", "show", "nope"]) == 1
+        capsys.readouterr()
+
+        # data-delete wipes events but keeps the app
+        from predictionio_tpu.data.event import Event
+        le = storage.get_levents()
+        le.insert(Event(event="rate", entity_type="user", entity_id="u",
+                        target_entity_type="item", target_entity_id="i"),
+                  app.id)
+        assert main(["app", "data-delete", "myapp", "-f"]) == 0
+        assert list(le.find(app.id)) == []
+        assert storage.get_metadata_apps().get_by_name("myapp") is not None
+
+        assert main(["app", "delete", "myapp", "-f"]) == 0
+        assert storage.get_metadata_apps().get_by_name("myapp") is None
+        assert storage.get_metadata_access_keys().get_by_appid(app.id) == []

@@ -1,0 +1,262 @@
+"""Engine train/eval dataflow wiring tests.
+
+Mirrors the assertions of the reference ``EngineTest``/``EngineTrainSuite``/
+``EngineEvalSuite`` (core/src/test/.../controller/) using identity-encoding
+stubs from dase_fixtures.
+"""
+
+import dataclasses
+
+import pytest
+
+from predictionio_tpu.controller import (
+    ComputeContext,
+    Engine,
+    EngineConfigError,
+    EngineParams,
+    RETRAIN,
+    PersistentModelManifest,
+    SimpleEngine,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+)
+from tests.dase_fixtures import (
+    Actual,
+    AlgoModel,
+    DataSource0,
+    FailingDataSource,
+    IdParams,
+    LAlgo0,
+    P2LAlgo0,
+    PAlgo0,
+    PersistedModel,
+    PersistentAlgo,
+    Preparator0,
+    Prediction,
+    ProcessedData,
+    Query,
+    Serving0,
+    SupplementingServing,
+    TrainingData,
+    UnsavablePersistedModel,
+)
+
+CTX = ComputeContext(_devices=("cpu0",))  # no jax needed for wiring tests
+
+
+def make_engine(algos=None, serving=Serving0, ds=DataSource0):
+    return Engine(ds, Preparator0, algos or {"": PAlgo0}, serving)
+
+
+def ep(ds_id=1, prep_id=2, algos=(("", 3),), serving_id=9, **ds_kw):
+    return EngineParams(
+        data_source_params=("", IdParams(ds_id, **ds_kw)),
+        preparator_params=("", IdParams(prep_id)),
+        algorithm_params_list=[(n, IdParams(i)) for n, i in algos],
+        serving_params=("", IdParams(serving_id)),
+    )
+
+
+class TestTrain:
+    def test_single_algo_dataflow(self):
+        engine = make_engine()
+        models = engine.train(CTX, ep(), "inst0", WorkflowParams())
+        # PAlgorithm without PersistentModel -> RETRAIN persisted form
+        assert models == [RETRAIN]
+
+    def test_p2l_models_flow_through(self):
+        engine = make_engine({"": P2LAlgo0})
+        models = engine.train(CTX, ep(ds_id=7, prep_id=8, algos=(("", 5),)),
+                              "inst0")
+        assert models == [
+            AlgoModel(5, ProcessedData(8, TrainingData(7)))]
+
+    def test_multi_algo_order_and_params(self):
+        engine = make_engine({"a": P2LAlgo0, "b": LAlgo0})
+        models = engine.train(
+            CTX, ep(algos=(("a", 10), ("b", 11), ("a", 12))), "i")
+        assert [m.id for m in models] == [10, 11, 12]
+        # every algorithm saw the same prepared data
+        assert all(m.pd == ProcessedData(2, TrainingData(1)) for m in models)
+
+    def test_requires_algorithms(self):
+        engine = make_engine()
+        with pytest.raises(EngineConfigError, match="at least 1"):
+            engine.train(CTX, EngineParams(algorithm_params_list=[]), "i")
+
+    def test_unknown_algo_name(self):
+        engine = make_engine()
+        with pytest.raises(EngineConfigError, match="not registered"):
+            engine.train(CTX, ep(algos=(("nope", 1),)), "i")
+
+    def test_sanity_check_failure(self):
+        engine = make_engine(ds=FailingDataSource)
+        with pytest.raises(AssertionError, match="Not Error"):
+            engine.train(CTX, ep(), "i")
+        # skip_sanity_check bypasses it (Engine.scala:634-638)
+        engine.train(CTX, ep(), "i",
+                     WorkflowParams(skip_sanity_check=True))
+
+    def test_stop_after_read_and_prepare(self):
+        engine = make_engine()
+        with pytest.raises(StopAfterReadInterruption):
+            engine.train(CTX, ep(), "i", WorkflowParams(stop_after_read=True))
+        with pytest.raises(StopAfterPrepareInterruption):
+            engine.train(CTX, ep(), "i",
+                         WorkflowParams(stop_after_prepare=True))
+
+
+class TestPersistence:
+    def test_persistent_model_saved_and_manifested(self):
+        PersistedModel.store.clear()
+        engine = make_engine({"": PersistentAlgo})
+        models = engine.train(CTX, ep(algos=(("", 4),)), "inst7")
+        assert isinstance(models[0], PersistentModelManifest)
+        assert "PersistedModel" in models[0].class_path
+        assert "inst7-0-" in next(iter(PersistedModel.store))
+
+    def test_prepare_deploy_loads_manifest(self):
+        PersistedModel.store.clear()
+        engine = make_engine({"": PersistentAlgo})
+        params = ep(algos=(("", 4),))
+        persisted = engine.train(CTX, params, "inst8")
+        out = engine.prepare_deploy(CTX, params, "inst8", persisted)
+        assert isinstance(out[0], PersistedModel)
+        assert out[0].id == 4
+
+    def test_prepare_deploy_retrains_retrain_sentinel(self):
+        engine = make_engine({"": PAlgo0})
+        params = ep(ds_id=1, prep_id=2, algos=(("", 3),))
+        persisted = engine.train(CTX, params, "inst9")
+        assert persisted == [RETRAIN]
+        out = engine.prepare_deploy(CTX, params, "inst9", persisted)
+        # model was re-trained from the data source (Engine.scala:208-230)
+        assert out == [AlgoModel(3, ProcessedData(2, TrainingData(1)))]
+
+    def test_unsavable_persistent_model_becomes_retrain(self):
+        class Algo(PersistentAlgo):
+            def train(self, ctx, pd):
+                return UnsavablePersistedModel(self.params.id)
+
+        engine = make_engine({"": Algo})
+        persisted = engine.train(CTX, ep(), "i")
+        assert persisted == [RETRAIN]
+
+    def test_mismatched_model_count(self):
+        engine = make_engine()
+        with pytest.raises(EngineConfigError, match="persisted models"):
+            engine.prepare_deploy(CTX, ep(), "i", [RETRAIN, RETRAIN])
+
+
+class TestEval:
+    def test_eval_dataflow(self):
+        engine = make_engine({"a": PAlgo0, "b": P2LAlgo0})
+        params = EngineParams(
+            data_source_params=("", IdParams(1, en=2, qn=3)),
+            preparator_params=("", IdParams(2)),
+            algorithm_params_list=[("a", 4), ("b", 5)] and
+            [("a", IdParams(4)), ("b", IdParams(5))],
+            serving_params=("", IdParams(9)),
+        )
+        results = engine.eval(CTX, params)
+        assert len(results) == 2  # en eval sets
+        for ex, (eval_info, qpa) in enumerate(results):
+            assert eval_info.id == 1
+            assert len(qpa) == 3  # qn queries
+            for qx, (q, p, a) in enumerate(qpa):
+                assert q == Query(1, ex=ex, qx=qx)
+                assert a == Actual(1, ex=ex, qx=qx)
+                # serve saw predictions in algorithm order
+                assert [pp.id for pp in p.ps] == [4, 5]
+                # every algorithm trained on the same prepared data
+                assert all(
+                    pp.model == AlgoModel(pp.id,
+                                          ProcessedData(2, TrainingData(1)))
+                    for pp in p.ps)
+
+    def test_supplement_reaches_predict_not_serve(self):
+        engine = make_engine({"": PAlgo0}, serving=SupplementingServing)
+        params = EngineParams(
+            data_source_params=("", IdParams(1, en=1, qn=2)),
+            preparator_params=("", IdParams(2)),
+            algorithm_params_list=[("", IdParams(3))],
+            serving_params=("", IdParams(9)),
+        )
+        [(_, qpa)] = engine.eval(CTX, params)
+        for q, p, _a in qpa:
+            assert q.supp is False          # original query served
+            assert p.q.supp is True         # predict saw supplemented query
+            assert p.ps[0].q.supp is True
+
+    def test_batch_eval_returns_params_pairs(self):
+        engine = make_engine({"": PAlgo0})
+        ps = [EngineParams(
+                  data_source_params=("", IdParams(i, en=1, qn=1)),
+                  preparator_params=("", IdParams(0)),
+                  algorithm_params_list=[("", IdParams(0))],
+                  serving_params=("", IdParams(0)))
+              for i in (1, 2)]
+        out = engine.batch_eval(CTX, ps)
+        assert [epp.data_source_params[1].id for epp, _ in out] == [1, 2]
+        assert [r[0][0].id for _, r in out] == [1, 2]
+
+
+class TestVariantParams:
+    def test_variant_extraction(self):
+        engine = make_engine({"als": PAlgo0, "nb": P2LAlgo0})
+        params = engine.engine_params_from_variant({
+            "datasource": {"params": {"id": 1, "en": 2}},
+            "preparator": {"params": {"id": 5}},
+            "algorithms": [
+                {"name": "als", "params": {"id": 7}},
+                {"name": "nb", "params": {"id": 8, "qn": 1}},
+            ],
+            "serving": {"params": {"id": 9}},
+        })
+        assert params.data_source_params == ("", IdParams(1, en=2))
+        assert params.preparator_params == ("", IdParams(5))
+        assert params.algorithm_params_list == [
+            ("als", IdParams(7)), ("nb", IdParams(8, qn=1))]
+        assert params.serving_params == ("", IdParams(9))
+
+    def test_unknown_param_rejected(self):
+        engine = make_engine()
+        with pytest.raises(EngineConfigError, match="unknown param"):
+            engine.engine_params_from_variant(
+                {"datasource": {"params": {"id": 1, "bogus": 2}}})
+
+    def test_missing_required_param_rejected(self):
+        engine = make_engine()
+        with pytest.raises(EngineConfigError, match="missing required"):
+            engine.engine_params_from_variant(
+                {"datasource": {"params": {"en": 2}}})
+
+    def test_unknown_algorithm_name_rejected(self):
+        engine = make_engine()
+        with pytest.raises(EngineConfigError, match="not registered"):
+            engine.engine_params_from_variant(
+                {"datasource": {"params": {"id": 1}},
+                 "algorithms": [{"name": "zzz", "params": {}}]})
+
+    def test_bare_params_block(self):
+        # bare {...} without name/params wrapper binds to the "" controller
+        engine = make_engine()
+        params = engine.engine_params_from_variant(
+            {"datasource": {"id": 3}})
+        assert params.data_source_params == ("", IdParams(3))
+
+
+class TestSimpleEngine:
+    def test_wiring(self):
+        engine = SimpleEngine(DataSource0, P2LAlgo0)
+        params = EngineParams(
+            data_source_params=("", IdParams(1, en=1, qn=1)),
+            algorithm_params_list=[("", IdParams(3))],
+        )
+        models = engine.train(CTX, params, "i")
+        # identity preparator passes TrainingData straight through
+        assert models == [AlgoModel(3, TrainingData(1))]
+        [(_, qpa)] = engine.eval(CTX, params)
+        [(q, p, a)] = qpa
+        assert isinstance(p, Prediction) and p.id == 3  # first serving

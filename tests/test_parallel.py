@@ -1,0 +1,57 @@
+"""Multi-device sharding tests on the virtual 8-device CPU mesh
+(conftest.py sets xla_force_host_platform_device_count=8 — the local-mode
+cluster substitution, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import ALSParams, pad_ratings, train_als
+from predictionio_tpu.parallel import data_parallel_mesh, train_als_sharded
+from tests.test_als import synthetic_ratings
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU scaffold")
+    return data_parallel_mesh(8)
+
+
+class TestShardedALS:
+    def test_matches_single_device_numerics(self, mesh8):
+        rows, cols, vals = synthetic_ratings(50, 30, 4, 0.3)
+        user_side = pad_ratings(rows, cols, vals, 50, 30)
+        item_side = pad_ratings(cols, rows, vals, 30, 50)
+        params = ALSParams(rank=6, num_iterations=4, lambda_=0.05, seed=5)
+
+        X1, Y1 = train_als(user_side, item_side, params)
+        X8, Y8 = train_als_sharded(user_side, item_side, params, mesh8)
+
+        np.testing.assert_allclose(X8, X1, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(Y8, Y1, rtol=1e-4, atol=1e-5)
+
+    def test_uneven_rows_are_padded(self, mesh8):
+        # 13 users over 8 devices: padding must not change results
+        rows, cols, vals = synthetic_ratings(13, 9, 2, 0.5, seed=2)
+        user_side = pad_ratings(rows, cols, vals, 13, 9)
+        item_side = pad_ratings(cols, rows, vals, 9, 13)
+        params = ALSParams(rank=4, num_iterations=2, seed=1)
+        X1, Y1 = train_als(user_side, item_side, params)
+        X8, Y8 = train_als_sharded(user_side, item_side, params, mesh8)
+        assert X8.shape == X1.shape and Y8.shape == Y1.shape
+        np.testing.assert_allclose(X8, X1, rtol=1e-4, atol=1e-5)
+
+    def test_mesh_helpers(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        from predictionio_tpu.parallel.mesh import mesh_2d
+
+        m = mesh_2d(4, 2)
+        assert m.devices.shape == (4, 2)
+        assert m.axis_names == ("data", "model")
+        with pytest.raises(ValueError):
+            mesh_2d(16, 16)

@@ -1,0 +1,155 @@
+"""End-to-end recommendation template test: events -> train -> persist ->
+reload -> predict (the SURVEY §7 minimum slice, in-process)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import ComputeContext, EngineParams
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.ops.als import ALSParams
+from predictionio_tpu.templates.recommendation import (
+    ALSAlgorithm,
+    ALSModel,
+    DataSourceParams,
+    PredictedResult,
+    Query,
+    engine_factory,
+)
+from predictionio_tpu.workflow import (
+    deserialize_models, run_train,
+)
+from predictionio_tpu.workflow.create_workflow import (
+    WorkflowConfig, new_engine_instance,
+)
+
+UTC = dt.timezone.utc
+CTX = ComputeContext()
+
+
+@pytest.fixture
+def rated_app(mem_storage):
+    """App with clustered synthetic ratings: users 0..9 like items a*,
+    users 10..19 like items b*."""
+    apps = storage.get_metadata_apps()
+    aid = apps.insert(App(0, "recapp"))
+    le = storage.get_levents()
+    le.init(aid)
+    rng = np.random.default_rng(0)
+    events = []
+    t0 = dt.datetime(2021, 1, 1, tzinfo=UTC)
+    for u in range(20):
+        group = "a" if u < 10 else "b"
+        other = "b" if u < 10 else "a"
+        for j in range(8):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"{group}{rng.integers(0, 10)}",
+                properties={"rating": float(rng.integers(4, 6))},
+                event_time=t0))
+        # one low-affinity cross-group rating
+        events.append(Event(
+            event="rate", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item",
+            target_entity_id=f"{other}{rng.integers(0, 10)}",
+            properties={"rating": 1.0}, event_time=t0))
+    le.insert_batch(events, aid)
+    return aid
+
+
+def engine_params():
+    return EngineParams(
+        data_source_params=("", DataSourceParams(app_name="recapp")),
+        algorithm_params_list=[
+            ("als", ALSParams(rank=8, num_iterations=8, lambda_=0.05,
+                              seed=42))],
+    )
+
+
+class TestTemplate:
+    def test_train_and_predict(self, rated_app):
+        engine = engine_factory()
+        models = engine.train(CTX, engine_params(), "t1")
+        [model] = models
+        assert isinstance(model, ALSModel)
+        algo = ALSAlgorithm(ALSParams())
+        result = algo.predict(model, Query(user="u1", num=5))
+        assert isinstance(result, PredictedResult)
+        assert 0 < len(result.item_scores) <= 5
+        # group-a user gets group-a recommendations
+        rec_groups = {s.item[0] for s in result.item_scores[:3]}
+        assert "a" in rec_groups
+
+    def test_seen_items_never_recommended(self, rated_app):
+        engine = engine_factory()
+        [model] = engine.train(CTX, engine_params(), "t2")
+        algo = ALSAlgorithm(ALSParams())
+        uidx = model.user_map["u1"]
+        seen_items = set(model.item_map.decode(model.seen[uidx]))
+        result = algo.predict(model, Query(user="u1", num=50))
+        assert not ({s.item for s in result.item_scores} & seen_items)
+
+    def test_unknown_user_returns_empty(self, rated_app):
+        engine = engine_factory()
+        [model] = engine.train(CTX, engine_params(), "t3")
+        algo = ALSAlgorithm(ALSParams())
+        assert algo.predict(model, Query(user="ghost")).item_scores == ()
+
+    def test_item_similarity_query(self, rated_app):
+        engine = engine_factory()
+        [model] = engine.train(CTX, engine_params(), "t4")
+        algo = ALSAlgorithm(ALSParams())
+        result = algo.predict(model, Query(items=("a1",), num=5))
+        assert result.item_scores
+        assert all(s.item != "a1" for s in result.item_scores)
+
+    def test_blacklist(self, rated_app):
+        engine = engine_factory()
+        [model] = engine.train(CTX, engine_params(), "t5")
+        algo = ALSAlgorithm(ALSParams())
+        full = algo.predict(model, Query(user="u1", num=3))
+        banned = full.item_scores[0].item
+        filtered = algo.predict(
+            model, Query(user="u1", num=3, blacklist=(banned,)))
+        assert banned not in {s.item for s in filtered.item_scores}
+
+    def test_full_workflow_roundtrip(self, rated_app):
+        """train via runner -> model blob -> reload -> predict (the
+        three-mode persistence path, automatic mode)."""
+        engine = engine_factory()
+        cfg = WorkflowConfig(engine_id="rec", engine_version="1",
+                             engine_variant="v.json")
+        params = engine_params()
+        iid = run_train(engine, params,
+                        new_engine_instance(cfg, params), ctx=CTX)
+        blob = storage.get_model_data_models().get(iid)
+        models = deserialize_models(blob.models)
+        restored = engine.prepare_deploy(CTX, params, iid, models)
+        algo = ALSAlgorithm(ALSParams())
+        result = algo.predict(restored[0], Query(user="u5", num=3))
+        assert result.item_scores
+
+    def test_eval_dataflow_producing_qpa(self, rated_app):
+        engine = engine_factory()
+        results = engine.eval(CTX, engine_params())
+        [(info, qpa)] = results
+        assert len(qpa) == 20  # every user has >= 2 ratings
+        q, p, a = qpa[0]
+        assert isinstance(p, PredictedResult)
+        assert len(a.items) == 1
+
+    def test_variant_json_extraction(self, rated_app):
+        engine = engine_factory()
+        params = engine.engine_params_from_variant({
+            "datasource": {"params": {"app_name": "recapp",
+                                      "event_names": ["rate"]}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 4, "num_iterations": 2,
+                                       "lambda_": 0.1, "seed": 1}}],
+        })
+        models = engine.train(CTX, params, "t6")
+        assert models[0].user_factors.shape[1] == 4

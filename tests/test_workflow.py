@@ -1,0 +1,201 @@
+"""Workflow runner tests: EngineInstance lifecycle + model persistence.
+
+Mirrors the reference coverage of CoreWorkflow/CreateWorkflow
+(core/src/test/.../workflow/): INIT->COMPLETED recording, params snapshot,
+train -> reload-model -> predict round trip through the storage registry.
+"""
+
+import json
+
+import pytest
+
+from predictionio_tpu.controller import (
+    ComputeContext,
+    Engine,
+    EngineParams,
+    RETRAIN,
+    WorkflowParams,
+)
+from predictionio_tpu.data import storage
+from predictionio_tpu.workflow import (
+    WorkflowConfig,
+    create_workflow,
+    deserialize_models,
+    load_engine_factory,
+    run_evaluation,
+    run_train,
+)
+from tests.dase_fixtures import (
+    AlgoModel,
+    DataSource0,
+    IdParams,
+    P2LAlgo0,
+    PAlgo0,
+    PersistedModel,
+    PersistentAlgo,
+    Preparator0,
+    ProcessedData,
+    Query,
+    Serving0,
+    TrainingData,
+)
+from predictionio_tpu.workflow.create_workflow import new_engine_instance
+
+CTX = ComputeContext(_devices=("cpu0",))
+
+
+def make_engine(algos=None):
+    return Engine(DataSource0, Preparator0, algos or {"": P2LAlgo0}, Serving0)
+
+
+def make_params(algos=(("", 3),)):
+    return EngineParams(
+        data_source_params=("", IdParams(1, en=1, qn=2)),
+        preparator_params=("", IdParams(2)),
+        algorithm_params_list=[(n, IdParams(i)) for n, i in algos],
+        serving_params=("", IdParams(9)),
+    )
+
+
+def config(**kw):
+    kw.setdefault("engine_id", "testeng")
+    kw.setdefault("engine_version", "1")
+    kw.setdefault("engine_variant", "engine.json")
+    return WorkflowConfig(**kw)
+
+
+class TestRunTrain:
+    def test_records_instance_and_persists_models(self, mem_storage):
+        engine = make_engine()
+        instance = new_engine_instance(config(), make_params())
+        iid = run_train(engine, make_params(), instance, ctx=CTX)
+        assert iid
+        rec = storage.get_metadata_engine_instances().get(iid)
+        assert rec.status == "COMPLETED"
+        assert rec.end_time >= rec.start_time
+        # params snapshot round-trips
+        algos = json.loads(rec.algorithms_params)
+        assert algos == [{"name": "", "params": {"id": 3, "en": 0, "qn": 0}}]
+        # model blob deserializes to the trained model
+        blob = storage.get_model_data_models().get(iid)
+        models = deserialize_models(blob.models)
+        assert models == [AlgoModel(3, ProcessedData(2, TrainingData(1)))]
+
+    def test_interruption_returns_none(self, mem_storage):
+        engine = make_engine()
+        instance = new_engine_instance(config(), make_params())
+        iid = run_train(engine, make_params(), instance, ctx=CTX,
+                        params=WorkflowParams(stop_after_read=True))
+        assert iid is None
+
+    def test_failure_marks_failed(self, mem_storage):
+        class Boom(P2LAlgo0):
+            def train(self, ctx, pd):
+                raise RuntimeError("boom")
+
+        engine = make_engine({"": Boom})
+        instance = new_engine_instance(config(), make_params())
+        with pytest.raises(RuntimeError, match="boom"):
+            run_train(engine, make_params(), instance, ctx=CTX)
+        rows = storage.get_metadata_engine_instances().get_all()
+        assert [r.status for r in rows] == ["FAILED"]
+
+    def test_retrain_model_roundtrip(self, mem_storage):
+        """PAlgorithm persists RETRAIN; deploy retrains from source."""
+        engine = make_engine({"": PAlgo0})
+        params = make_params()
+        instance = new_engine_instance(config(), params)
+        iid = run_train(engine, params, instance, ctx=CTX)
+        models = deserialize_models(
+            storage.get_model_data_models().get(iid).models)
+        assert models == [RETRAIN]
+        restored = engine.prepare_deploy(CTX, params, iid, models)
+        assert restored == [AlgoModel(3, ProcessedData(2, TrainingData(1)))]
+        # restored model actually predicts
+        algo = PAlgo0(IdParams(3))
+        p = algo.predict_base(restored[0], Query(1))
+        assert p.model == restored[0]
+
+    def test_persistent_model_roundtrip(self, mem_storage):
+        PersistedModel.store.clear()
+        engine = make_engine({"": PersistentAlgo})
+        params = make_params(algos=(("", 6),))
+        instance = new_engine_instance(config(), params)
+        iid = run_train(engine, params, instance, ctx=CTX)
+        models = deserialize_models(
+            storage.get_model_data_models().get(iid).models)
+        restored = engine.prepare_deploy(CTX, params, iid, models)
+        assert isinstance(restored[0], PersistedModel)
+        assert restored[0].id == 6
+
+    def test_get_latest_completed_finds_instance(self, mem_storage):
+        engine = make_engine()
+        cfg = config()
+        iid1 = run_train(engine, make_params(),
+                         new_engine_instance(cfg, make_params()), ctx=CTX)
+        iid2 = run_train(engine, make_params(),
+                         new_engine_instance(cfg, make_params()), ctx=CTX)
+        latest = storage.get_metadata_engine_instances().get_latest_completed(
+            "testeng", "1", "engine.json")
+        assert latest.id in (iid1, iid2)
+
+
+class TestCreateWorkflow:
+    def test_variant_file_end_to_end(self, mem_storage, tmp_path):
+        variant = {
+            "datasource": {"params": {"id": 1}},
+            "preparator": {"params": {"id": 2}},
+            "algorithms": [{"name": "", "params": {"id": 3}}],
+            "serving": {"params": {"id": 9}},
+        }
+        vf = tmp_path / "engine.json"
+        vf.write_text(json.dumps(variant))
+        iid = create_workflow(
+            config(engine_variant=str(vf)), engine=make_engine())
+        rec = storage.get_metadata_engine_instances().get(iid)
+        assert rec.status == "COMPLETED"
+        assert rec.engine_variant == str(vf)
+
+    def test_engine_factory_loading(self):
+        factory = load_engine_factory("tests.test_workflow:make_engine")
+        assert isinstance(factory(), Engine)
+        with pytest.raises(ValueError):
+            load_engine_factory("no_colon_here")
+        with pytest.raises(ModuleNotFoundError):
+            load_engine_factory("nope.nope:f")
+
+
+class TestRunEvaluation:
+    def test_records_evaluation_instance(self, mem_storage):
+        import datetime as dt
+        from predictionio_tpu.core.base import (
+            BaseEvaluator, BaseEvaluatorResult)
+        from predictionio_tpu.data.storage.base import EvaluationInstance
+
+        class CountResult(BaseEvaluatorResult):
+            def __init__(self, n):
+                self.n = n
+
+            def to_one_liner(self):
+                return f"n={self.n}"
+
+            def to_json(self):
+                return json.dumps({"n": self.n})
+
+        class CountEvaluator(BaseEvaluator):
+            def evaluate_base(self, ctx, evaluation, eval_data, params):
+                n = sum(len(qpa) for _, sets in eval_data
+                        for _, qpa in sets)
+                return CountResult(n)
+
+        engine = make_engine()
+        now = dt.datetime.now(tz=dt.timezone.utc)
+        evi = EvaluationInstance(id="", status="INIT", start_time=now,
+                                 end_time=now)
+        result = run_evaluation(
+            engine, [make_params(), make_params()], evi, CountEvaluator(),
+            ctx=CTX)
+        assert result.n == 4  # 2 params sets × 1 eval set × 2 queries
+        rows = storage.get_metadata_evaluation_instances().get_completed()
+        assert rows[0].evaluator_results == "n=4"
+        assert json.loads(rows[0].evaluator_results_json) == {"n": 4}
