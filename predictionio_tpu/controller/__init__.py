@@ -27,6 +27,24 @@ from predictionio_tpu.controller.engine import (
     params_from_dict,
     params_to_dict,
 )
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+    MetricScores,
+)
+from predictionio_tpu.controller.fast_eval import FastEvalEngine
+from predictionio_tpu.controller.metrics import (
+    AverageMetric,
+    Metric,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    QPAMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
 from predictionio_tpu.controller.persistent import (
     PersistentModel,
     load_persistent_model,
@@ -44,9 +62,23 @@ from predictionio_tpu.core.base import (
 from predictionio_tpu.core.context import ComputeContext, workflow_context
 
 __all__ = [
+    "AverageMetric",
     "ComputeContext",
     "EmptyParams",
     "Engine",
+    "EngineParamsGenerator",
+    "Evaluation",
+    "FastEvalEngine",
+    "Metric",
+    "MetricEvaluator",
+    "MetricEvaluatorResult",
+    "MetricScores",
+    "OptionAverageMetric",
+    "OptionStdevMetric",
+    "QPAMetric",
+    "StdevMetric",
+    "SumMetric",
+    "ZeroMetric",
     "EngineConfigError",
     "EngineParams",
     "IdentityPreparator",

@@ -1,0 +1,192 @@
+"""FastEvalEngine — per-prefix memoization for hyper-parameter tuning.
+
+Parity target: ``controller/FastEvalEngine.scala:50-342``. Exploits
+controller immutability: when many EngineParams share a prefix
+(datasource / +preparator / +algorithms / +serving params), each distinct
+prefix computes once and later param sets reuse the cached result.
+
+Faithful quirk kept from the reference: the algorithms stage batch-predicts
+on the RAW queries — ``FastEvalEngine.scala:178`` maps out ``_._1`` with no
+``supplementBase`` call (the algorithms prefix cannot see serving params),
+unlike ``Engine.eval`` which supplements first.
+
+Cache keys: the reference hashes Params case classes structurally
+(``DataSourcePrefix`` etc., ``FastEvalEngine.scala:50-83``); here prefixes
+are keyed by canonical JSON of the (name, params) pairs, so params classes
+need not be hashable.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.controller.engine import (
+    Engine, EngineParams, params_to_dict,
+)
+from predictionio_tpu.core.base import WorkflowParams
+from predictionio_tpu.core.context import ComputeContext
+
+
+def _canonical(value: Any) -> Any:
+    """Lossless JSON-able form for cache keys. numpy arrays hash by dtype +
+    shape + raw bytes (repr would elide large arrays and collide); objects
+    without a value-based form are rejected rather than silently keyed by
+    identity."""
+    import hashlib
+
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_canonical(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _canonical(v) for k, v in sorted(value.items())}
+    if isinstance(value, bytes):
+        return ["__bytes__", hashlib.sha256(value).hexdigest()]
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return ["__ndarray__", str(value.dtype), list(value.shape),
+                    hashlib.sha256(np.ascontiguousarray(value).tobytes())
+                    .hexdigest()]
+        if isinstance(value, np.generic):
+            return value.item()
+    except ImportError:
+        pass
+    raise TypeError(
+        f"FastEvalEngine cannot derive a value-based cache key for params "
+        f"field of type {type(value).__name__}; use plain "
+        f"JSON-able values or numpy arrays in Params")
+
+
+def _np_key(name_params: Tuple[str, Any]) -> str:
+    name, params = name_params
+    return json.dumps([name, _canonical(params_to_dict(params))],
+                      sort_keys=True)
+
+
+def _ds_key(ep: EngineParams) -> str:
+    return _np_key(ep.data_source_params)
+
+
+def _prep_key(ep: EngineParams) -> str:
+    return _ds_key(ep) + "|" + _np_key(ep.preparator_params)
+
+
+def _algo_key(ep: EngineParams) -> str:
+    return (_prep_key(ep) + "|" +
+            json.dumps([_np_key(np) for np in ep.algorithm_params_list]))
+
+
+def _serving_key(ep: EngineParams) -> str:
+    return _algo_key(ep) + "|" + _np_key(ep.serving_params)
+
+
+class FastEvalEngineWorkflow:
+    """The four prefix caches (FastEvalEngineWorkflow, :295-298)."""
+
+    def __init__(self, engine: "FastEvalEngine", ctx: ComputeContext):
+        self.engine = engine
+        self.ctx = ctx
+        # key -> [(td, ei, [(qx, (q, a)), ...]), ...]   per eval set
+        self.data_source_cache: Dict[str, List[Tuple[Any, Any, List]]] = {}
+        # key -> [pd, ...] per eval set
+        self.preparator_cache: Dict[str, List[Any]] = {}
+        # key -> [{qx: [p per algorithm]}, ...] per eval set
+        self.algorithms_cache: Dict[str, List[Dict[int, List[Any]]]] = {}
+        # key -> [(ei, [(q, p, a), ...]), ...]
+        self.serving_cache: Dict[str, List[Tuple[Any, List]]] = {}
+
+    def get_data_source_result(self, ep: EngineParams):
+        key = _ds_key(ep)
+        if key not in self.data_source_cache:
+            name, params = ep.data_source_params
+            ds = self.engine._make(self.engine.data_source_class_map, name,
+                                   params, "datasource")
+            result = [
+                (td, ei, list(enumerate(qa_pairs)))
+                for td, ei, qa_pairs in ds.read_eval_base(self.ctx)
+            ]
+            self.data_source_cache[key] = result
+        return self.data_source_cache[key]
+
+    def get_preparator_result(self, ep: EngineParams):
+        key = _prep_key(ep)
+        if key not in self.preparator_cache:
+            name, params = ep.preparator_params
+            prep = self.engine._make(self.engine.preparator_class_map, name,
+                                     params, "preparator")
+            self.preparator_cache[key] = [
+                prep.prepare_base(self.ctx, td)
+                for td, _ei, _qas in self.get_data_source_result(ep)
+            ]
+        return self.preparator_cache[key]
+
+    def get_algorithms_result(self, ep: EngineParams):
+        key = _algo_key(ep)
+        if key not in self.algorithms_cache:
+            algorithms = self.engine._algorithms(ep)
+            pds = self.get_preparator_result(ep)
+            ds_result = self.get_data_source_result(ep)
+            per_eval: List[Dict[int, List[Any]]] = []
+            for pd, (_td, _ei, indexed_qas) in zip(pds, ds_result):
+                models = [a.train_base(self.ctx, pd) for a in algorithms]
+                queries = [(qx, q) for qx, (q, _a) in indexed_qas]
+                by_qx: Dict[int, Dict[int, Any]] = {}
+                for ax, (algo, model) in enumerate(zip(algorithms, models)):
+                    for qx, p in algo.batch_predict_base(
+                            self.ctx, model, queries):
+                        by_qx.setdefault(qx, {})[ax] = p
+                for qx, ps in by_qx.items():
+                    if len(ps) != len(algorithms):
+                        raise RuntimeError(
+                            f"query {qx}: got predictions from "
+                            f"{sorted(ps)} but expected all "
+                            f"{len(algorithms)} algorithms")
+                per_eval.append({
+                    qx: [ps[ax] for ax in range(len(algorithms))]
+                    for qx, ps in by_qx.items()
+                })
+            self.algorithms_cache[key] = per_eval
+        return self.algorithms_cache[key]
+
+    def get_serving_result(self, ep: EngineParams):
+        key = _serving_key(ep)
+        if key not in self.serving_cache:
+            name, params = ep.serving_params
+            serving = self.engine._make(self.engine.serving_class_map, name,
+                                        params, "serving")
+            predicts = self.get_algorithms_result(ep)
+            ds_result = self.get_data_source_result(ep)
+            result: List[Tuple[Any, List]] = []
+            for ps_map, (_td, ei, indexed_qas) in zip(predicts, ds_result):
+                missing = [qx for qx, _qa in indexed_qas if qx not in ps_map]
+                if missing:
+                    raise RuntimeError(
+                        f"queries {missing} got no predictions from any "
+                        f"algorithm")
+                qpa = [(q, serving.serve_base(q, ps_map[qx]), a)
+                       for qx, (q, a) in indexed_qas]
+                result.append((ei, qpa))
+            self.serving_cache[key] = result
+        return self.serving_cache[key]
+
+    def get(self, engine_params_list: Sequence[EngineParams]):
+        return [(ep, self.get_serving_result(ep))
+                for ep in engine_params_list]
+
+
+class FastEvalEngine(Engine):
+    """Engine whose batch_eval memoizes shared prefixes
+    (FastEvalEngine.scala:306-342)."""
+
+    def eval(self, ctx: ComputeContext, engine_params: EngineParams,
+             params: Optional[WorkflowParams] = None):
+        return self.batch_eval(ctx, [engine_params], params)[0][1]
+
+    def batch_eval(self, ctx: ComputeContext,
+                   engine_params_list: Sequence[EngineParams],
+                   params: Optional[WorkflowParams] = None):
+        workflow = FastEvalEngineWorkflow(self, ctx)
+        return workflow.get(list(engine_params_list))

@@ -234,22 +234,27 @@ class SqliteClient:
                 return self._shared_conn.execute(sql, tuple(args)).fetchall()
         return self.conn().execute(sql, tuple(args)).fetchall()
 
-    def query_iter(self, sql: str, args: Sequence[Any] = (),
-                   chunk: int = 4096):
-        """Streaming read for large scans. File-backed: iterate the cursor
-        directly (WAL snapshot, own connection). Shared :memory:: fetch in
-        chunks, holding the tx lock only per chunk so writers are not
-        starved for the whole scan."""
-        if self._shared_conn is None:
-            yield from self.conn().execute(sql, tuple(args))
-            return
-        with self._tx_lock:
-            cur = self._shared_conn.execute(sql, tuple(args))
-            rows = cur.fetchmany(chunk)
-        while rows:
-            yield from rows
+    def query_iter(self, sql: str, args: Sequence[Any] = ()):
+        """Streaming read with snapshot semantics for large scans.
+
+        File-backed: a FRESH read connection per scan, so the WAL snapshot
+        isolates it from writes the caller makes through its own connection
+        while iterating (same-connection write-while-step visibility is
+        undefined in sqlite). Shared ``:memory:``: no second connection can
+        see the data, so materialize under the tx lock instead.
+        """
+        if self._shared_conn is not None:
             with self._tx_lock:
-                rows = cur.fetchmany(chunk)
+                rows = self._shared_conn.execute(sql, tuple(args)).fetchall()
+            yield from rows
+            return
+        if self._closed:
+            raise base.StorageError(f"SqliteClient({self.path}) is shut down")
+        conn = sqlite3.connect(self.path, timeout=30.0)
+        try:
+            yield from conn.execute(sql, tuple(args))
+        finally:
+            conn.close()
 
     def query_one(self, sql: str, args: Sequence[Any] = ()) -> Optional[tuple]:
         rows = self.query(sql, args)
@@ -429,6 +434,9 @@ class SqliteLEvents(base.LEvents):
                f"ORDER BY event_time {order}")
         if limit is not None and limit >= 0:
             sql += f" LIMIT {int(limit)}"
+        # query_iter gives snapshot semantics (fresh WAL read connection
+        # for files; materialized under lock for shared :memory:) so
+        # callers may write while iterating.
         for row in self._client.query_iter(sql, args):
             yield _row_to_event(row)
 

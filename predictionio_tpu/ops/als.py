@@ -57,13 +57,15 @@ class PaddedRatings:
     """One side's ragged ratings padded to ``[n_rows, max_len]``.
 
     ``cols[i, j]`` is the column index of the j-th rating of row i (0 when
-    padded); ``weights[i, j]`` is its rating value, 0.0 on padding — a zero
-    weight makes the padded entry contribute nothing to either the implicit
-    correction or the explicit normal equations.
+    padded); ``weights[i, j]`` is its rating value; ``mask[i, j]`` is 1.0
+    for real entries and 0.0 for padding. The explicit mask (rather than
+    ``weights > 0``) keeps zero/negative explicit ratings distinguishable
+    from padding.
     """
 
     cols: np.ndarray      # int32 [n_rows, L]
     weights: np.ndarray   # float32 [n_rows, L]
+    mask: np.ndarray      # float32 [n_rows, L]
     n_rows: int
     n_cols: int
 
@@ -80,8 +82,8 @@ def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
 
     Duplicate (row, col) pairs are summed first — the template's
     ``reduceByKey(_ + _)`` aggregation (custom-query ALSAlgorithm.scala:50).
-    ``max_len`` truncates pathological rows (keeping the HIGHEST-weight
-    ratings) to bound memory; default keeps everything.
+    ``max_len`` truncates pathological rows (keeping the
+    largest-magnitude ratings) to bound memory; default keeps everything.
     """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
@@ -101,7 +103,7 @@ def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
         L = int(max_len)
     L = max(1, -(-L // pad_multiple) * pad_multiple)
 
-    order = np.lexsort((-values, rows))  # by row, heaviest first
+    order = np.lexsort((-np.abs(values), rows))  # by row, strongest first
     rows, cols, values = rows[order], cols[order], values[order]
     # position of each rating within its row
     row_starts = np.zeros(n_rows + 1, dtype=np.int64)
@@ -112,9 +114,11 @@ def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
 
     out_cols = np.zeros((n_rows, L), dtype=np.int32)
     out_w = np.zeros((n_rows, L), dtype=np.float32)
+    out_m = np.zeros((n_rows, L), dtype=np.float32)
     out_cols[rows, pos] = cols
     out_w[rows, pos] = values
-    return PaddedRatings(out_cols, out_w, n_rows, n_cols)
+    out_m[rows, pos] = 1.0
+    return PaddedRatings(out_cols, out_w, out_m, n_rows, n_cols)
 
 
 def transpose_ratings(pr: PaddedRatings, rows: np.ndarray, cols: np.ndarray,
@@ -129,35 +133,41 @@ def transpose_ratings(pr: PaddedRatings, rows: np.ndarray, cols: np.ndarray,
 # Device kernels
 # ---------------------------------------------------------------------------
 
-def _solve_side(Y, cols, weights, lam: float, alpha: float,
+def _solve_side(Y, cols, weights, mask, lam: float, alpha: float,
                 implicit: bool):
     """One alternating half-step: given fixed factors ``Y [M, R]`` and this
-    side's padded ratings ``[B, L]``, return new factors ``[B, R]``.
+    side's padded ratings ``[B, L]`` (+ validity mask), return new factors
+    ``[B, R]``.
 
     jit-friendly: static shapes, two einsums + batched Cholesky; runs on
-    the MXU. Written to be shard_map-compatible: only ``cols``/``weights``
-    carry the batch dimension.
+    the MXU. Written to be shard_map-compatible: only ``cols``/``weights``/
+    ``mask`` carry the batch dimension.
     """
     import jax
     import jax.numpy as jnp
 
     R = Y.shape[1]
     Yg = jnp.take(Y, cols, axis=0)            # [B, L, R] gather
-    mask = (weights > 0).astype(Y.dtype)      # padding has weight 0
-    w = weights.astype(Y.dtype)
+    mask = mask.astype(Y.dtype)
+    w = weights.astype(Y.dtype) * mask        # zero out padded slots
     # Normal equations are precision-sensitive: force full fp32 MXU passes
     # instead of TPU's default bf16 matmul decomposition (cf. ALX §4).
     hi = jax.lax.Precision.HIGHEST
 
     if implicit:
-        # A_b = YtY + alpha * sum_j r_j y_j y_j^T + lam I
-        # b_b = sum_j (1 + alpha r_j) y_j          (p = 1)
+        # MLlib trainImplicit semantics: confidence c = 1 + alpha*|r|,
+        # preference p = 1 iff r > 0. |r| keeps A positive-definite when
+        # ratings carry negative signal (e.g. dislikes).
+        # A_b = YtY + alpha * sum_j |r_j| y_j y_j^T + lam I
+        # b_b = sum_j p_j (1 + alpha |r_j|) y_j
+        aw = alpha * jnp.abs(w)
+        pref = (w > 0).astype(Y.dtype)
         gram = jnp.matmul(Y.T, Y, precision=hi)                  # [R, R]
-        corr = jnp.einsum("bl,blr,bls->brs", alpha * w, Yg, Yg,
+        corr = jnp.einsum("bl,blr,bls->brs", aw, Yg, Yg,
                           precision=hi)                          # [B, R, R]
         A = gram[None, :, :] + corr
         A += lam * jnp.eye(R, dtype=Y.dtype)[None, :, :]
-        b = jnp.einsum("bl,blr->br", mask + alpha * w, Yg,
+        b = jnp.einsum("bl,blr->br", pref * (1.0 + aw), Yg,
                        precision=hi)                             # [B, R]
     else:
         # explicit ALS-WR: A_b = sum_j y_j y_j^T + lam n_b I; b = sum r y
@@ -174,16 +184,16 @@ def _solve_side(Y, cols, weights, lam: float, alpha: float,
     return X * has_any[:, None]
 
 
-def _als_iterations_impl(X, Y, u_cols, u_w, i_cols, i_w, *, lam, alpha,
-                         implicit, num_iterations):
+def _als_iterations_impl(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m, *, lam,
+                         alpha, implicit, num_iterations):
     """Full training loop as one compiled program (lax.scan over
     iterations; no data-dependent Python control flow)."""
     import jax
 
     def body(carry, _):
         X, Y = carry
-        X = _solve_side(Y, u_cols, u_w, lam, alpha, implicit)
-        Y = _solve_side(X, i_cols, i_w, lam, alpha, implicit)
+        X = _solve_side(Y, u_cols, u_w, u_m, lam, alpha, implicit)
+        Y = _solve_side(X, i_cols, i_w, i_m, lam, alpha, implicit)
         return (X, Y), None
 
     (X, Y), _ = jax.lax.scan(body, (X, Y), None, length=num_iterations)
@@ -236,10 +246,12 @@ def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
                         params.seed, dtype)
     u_cols = jnp.asarray(user_side.cols)
     u_w = jnp.asarray(user_side.weights)
+    u_m = jnp.asarray(user_side.mask)
     i_cols = jnp.asarray(item_side.cols)
     i_w = jnp.asarray(item_side.weights)
+    i_m = jnp.asarray(item_side.mask)
     X, Y = _als_iterations(
-        X, Y, u_cols, u_w, i_cols, i_w,
+        X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m,
         lam=float(params.lambda_), alpha=float(params.alpha),
         implicit=bool(params.implicit_prefs),
         num_iterations=int(params.num_iterations))

@@ -57,8 +57,10 @@ def train_als_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
     n_i = -(-item_side.n_rows // n_dev) * n_dev
     u_cols = _pad_rows_to(user_side.cols, n_u)
     u_w = _pad_rows_to(user_side.weights, n_u)
+    u_m = _pad_rows_to(user_side.mask, n_u)
     i_cols = _pad_rows_to(item_side.cols, n_i)
     i_w = _pad_rows_to(item_side.weights, n_i)
+    i_m = _pad_rows_to(item_side.mask, n_i)
     X = _pad_rows_to(np.asarray(X), n_u)
     Y = _pad_rows_to(np.asarray(Y), n_i)
 
@@ -67,8 +69,10 @@ def train_als_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
 
     u_cols = jax.device_put(jnp.asarray(u_cols), row_sharded)
     u_w = jax.device_put(jnp.asarray(u_w), row_sharded)
+    u_m = jax.device_put(jnp.asarray(u_m), row_sharded)
     i_cols = jax.device_put(jnp.asarray(i_cols), row_sharded)
     i_w = jax.device_put(jnp.asarray(i_w), row_sharded)
+    i_m = jax.device_put(jnp.asarray(i_m), row_sharded)
     X = jax.device_put(jnp.asarray(X), replicated)
     Y = jax.device_put(jnp.asarray(Y), replicated)
 
@@ -81,7 +85,7 @@ def train_als_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
         # factor shuffle.
         out_shardings=(replicated, replicated),
     )
-    X, Y = step(X, Y, u_cols, u_w, i_cols, i_w,
+    X, Y = step(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m,
                 lam=float(params.lambda_), alpha=float(params.alpha),
                 implicit=bool(params.implicit_prefs),
                 num_iterations=int(params.num_iterations))
@@ -105,7 +109,7 @@ def sharded_train_step(mesh, rank: int, params: Optional[ALSParams] = None):
         out_shardings=(replicated, replicated),
     )
 
-    def run(X, Y, u_cols, u_w, i_cols, i_w):
+    def run(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m):
         import jax.numpy as jnp
 
         put = jax.device_put
@@ -113,8 +117,10 @@ def sharded_train_step(mesh, rank: int, params: Optional[ALSParams] = None):
                   put(jnp.asarray(Y), replicated),
                   put(jnp.asarray(u_cols), row_sharded),
                   put(jnp.asarray(u_w), row_sharded),
+                  put(jnp.asarray(u_m), row_sharded),
                   put(jnp.asarray(i_cols), row_sharded),
                   put(jnp.asarray(i_w), row_sharded),
+                  put(jnp.asarray(i_m), row_sharded),
                   lam=float(params.lambda_), alpha=float(params.alpha),
                   implicit=bool(params.implicit_prefs),
                   num_iterations=1)

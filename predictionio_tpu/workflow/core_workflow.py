@@ -131,7 +131,11 @@ def run_evaluation(
     try:
         eval_data = engine.batch_eval(ctx, list(engine_params_list), params)
         result = evaluator.evaluate_base(ctx, evaluation, eval_data, params)
-
+    except Exception:
+        evaluation_instances.update(dataclasses.replace(
+            instance, status="FAILED", end_time=_now()))
+        raise
+    else:
         if result.no_save:
             logger.info("Result not inserted into database: %r", result)
         else:

@@ -104,6 +104,13 @@ def create_workflow(
     if engine is None:
         factory = core_workflow.load_engine_factory(config.engine_factory)
         engine = factory()
+        # best.json written by tuning names the Evaluation class as the
+        # factory; unwrap its coupled engine so the tune -> train handoff
+        # works (the reference resolves Evaluation the same way,
+        # WorkflowUtils.getEngine + Evaluation extends Deployment).
+        from predictionio_tpu.controller.evaluation import Evaluation
+        if isinstance(engine, Evaluation):
+            engine = engine.engine
     if variant is None:
         with open(config.engine_variant, "r", encoding="utf-8") as f:
             variant = json.load(f)

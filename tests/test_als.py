@@ -92,7 +92,7 @@ class TestNumerics:
         pr = pad_ratings(rows, cols, vals, n_users, n_items)
         got = np.asarray(_solve_side(
             jnp.asarray(Y), jnp.asarray(pr.cols), jnp.asarray(pr.weights),
-            lam=0.1, alpha=1.0, implicit=True))
+            jnp.asarray(pr.mask), lam=0.1, alpha=1.0, implicit=True))
         want = numpy_implicit_als_step(
             Y.astype(np.float64), rows, cols, vals, n_users, 0.1, 1.0)
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
@@ -141,6 +141,53 @@ class TestNumerics:
         pred = (X @ Y.T)[rows, cols]
         # explicit mode regresses the rating values themselves
         err = np.abs(pred - vals).mean() / vals.mean()
+        assert err < 0.35
+
+    def test_implicit_mode_negative_signal_stays_finite(self):
+        """Implicit mode with negative ratings (dislikes): confidence uses
+        |r|, preference r>0 — factors stay finite and dislikes score below
+        likes (MLlib trainImplicit semantics)."""
+        rng = np.random.default_rng(9)
+        n_users, n_items = 40, 25
+        rows = np.repeat(np.arange(n_users), 6)
+        cols = rng.integers(0, n_items, rows.shape[0])
+        vals = np.where(rng.random(rows.shape[0]) < 0.3, -5.0,
+                        1.0 + 2 * rng.random(rows.shape[0])).astype(np.float32)
+        X, Y = train_als(
+            pad_ratings(rows, cols, vals, n_users, n_items),
+            pad_ratings(cols, rows, vals, n_items, n_users),
+            ALSParams(rank=6, num_iterations=8, lambda_=0.05, seed=1))
+        assert np.isfinite(X).all() and np.isfinite(Y).all()
+        S = X @ Y.T
+        # pad_ratings sums duplicates, so score by the summed sign
+        agg = {}
+        for r, c, v in zip(rows, cols, vals):
+            agg[(r, c)] = agg.get((r, c), 0.0) + v
+        liked = np.array([S[r, c] for (r, c), v in agg.items() if v > 0])
+        disliked = np.array([S[r, c] for (r, c), v in agg.items() if v < 0])
+        assert liked.mean() > disliked.mean() + 0.2
+
+    def test_explicit_mode_negative_and_zero_ratings(self):
+        """Zero/negative explicit ratings are real observations, not
+        padding: regression for the weights>0 masking bug."""
+        rng = np.random.default_rng(5)
+        n_users, n_items, rank = 30, 20, 4
+        Xt = rng.normal(size=(n_users, rank))
+        Yt = rng.normal(size=(n_items, rank))
+        R = Xt @ Yt.T  # dense signed "ratings" incl. negatives
+        rows, cols = np.nonzero(rng.random((n_users, n_items)) < 0.6)
+        vals = R[rows, cols].astype(np.float32)
+        assert (vals < 0).any()
+        X, Y = train_als(
+            pad_ratings(rows, cols, vals, n_users, n_items),
+            pad_ratings(cols, rows, vals, n_items, n_users),
+            ALSParams(rank=rank, num_iterations=10, lambda_=0.05,
+                      implicit_prefs=False, seed=3))
+        pred = (X @ Y.T)[rows, cols]
+        # negative ratings must be regressed toward negative predictions
+        neg = vals < -0.5
+        assert pred[neg].mean() < -0.2
+        err = np.abs(pred - vals).mean() / np.abs(vals).mean()
         assert err < 0.35
 
     def test_deterministic_given_seed(self):

@@ -1,0 +1,361 @@
+"""Evaluation & tuning tests.
+
+Mirrors the reference coverage: MetricTest (stats over eval sets),
+MetricEvaluatorTest (best selection), EvaluationTest (engine/evaluator
+coupling), FastEvalEngineTest (per-prefix cache hit counts).
+"""
+
+import dataclasses
+import json
+import math
+
+import pytest
+
+from predictionio_tpu.controller import (
+    ComputeContext,
+    Engine,
+    EngineParams,
+)
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+)
+from predictionio_tpu.controller.fast_eval import FastEvalEngine
+from predictionio_tpu.controller.metrics import (
+    AverageMetric,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from tests.dase_fixtures import (
+    DataSource0,
+    IdParams,
+    PAlgo0,
+    Preparator0,
+    Serving0,
+)
+
+CTX = ComputeContext(_devices=("cpu0",))
+
+
+# ---------------------------------------------------------------------------
+# Metrics (Metric.scala:96-244 semantics)
+# ---------------------------------------------------------------------------
+
+class QMetric(AverageMetric):
+    """Score = the query's numeric payload (MetricTest's Metric0 style)."""
+
+    def calculate_qpa(self, q, p, a):
+        return float(q)
+
+
+class QOptionMetric(OptionAverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return None if q is None else float(q)
+
+
+class QStdev(StdevMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(q)
+
+
+class QOptionStdev(OptionStdevMetric):
+    def calculate_qpa(self, q, p, a):
+        return None if q is None else float(q)
+
+
+class QSum(SumMetric):
+    def calculate_qpa(self, q, p, a):
+        return int(q)
+
+
+def eval_sets(*groups):
+    """[(EI, [(q, None, None) ...])] from raw per-set score lists."""
+    return [(i, [(q, None, None) for q in qs])
+            for i, qs in enumerate(groups)]
+
+
+def test_average_metric_spans_eval_sets():
+    data = eval_sets([1, 2, 3], [5])
+    assert QMetric().calculate(CTX, data) == pytest.approx(11 / 4)
+
+
+def test_option_average_skips_none():
+    data = eval_sets([1, None, 3], [None])
+    assert QOptionMetric().calculate(CTX, data) == pytest.approx(2.0)
+
+
+def test_stdev_is_population_stdev():
+    data = eval_sets([2, 4, 4, 4], [5, 5, 7, 9])
+    assert QStdev().calculate(CTX, data) == pytest.approx(2.0)
+
+
+def test_option_stdev_skips_none():
+    data = eval_sets([2, None, 4, 4, 4], [5, 5, None, 7, 9])
+    assert QOptionStdev().calculate(CTX, data) == pytest.approx(2.0)
+
+
+def test_sum_metric_keeps_type():
+    data = eval_sets([1, 2], [3])
+    assert QSum().calculate(CTX, data) == 6
+
+
+def test_zero_metric():
+    assert ZeroMetric().calculate(CTX, eval_sets([1, 2])) == 0.0
+
+
+def test_metric_compare_default_bigger_wins():
+    m = QMetric()
+    assert m.compare(2.0, 1.0) > 0
+    assert m.compare(1.0, 1.0) == 0
+    assert m.compare(0.0, 1.0) < 0
+
+
+# ---------------------------------------------------------------------------
+# MetricEvaluator (MetricEvaluator.scala:215-246)
+# ---------------------------------------------------------------------------
+
+class DSIdMetric(AverageMetric):
+    """Scores eval output by the data-source id stamped into the query."""
+
+    def calculate_qpa(self, q, p, a):
+        return float(q.id)
+
+
+def grid_engine():
+    return Engine(DataSource0, Preparator0, {"": PAlgo0}, Serving0)
+
+
+def grid_params(ds_ids):
+    return [EngineParams(
+        data_source_params=("", IdParams(i, en=1, qn=2)),
+        preparator_params=("", IdParams(0)),
+        algorithm_params_list=[("", IdParams(0))],
+        serving_params=("", IdParams(0)),
+    ) for i in ds_ids]
+
+
+def test_metric_evaluator_picks_best(tmp_path):
+    engine = grid_engine()
+    params_list = grid_params([3, 7, 5])
+    eval_data = engine.batch_eval(CTX, params_list)
+    out = str(tmp_path / "best.json")
+    evaluator = MetricEvaluator(DSIdMetric(), output_path=out)
+    result = evaluator.evaluate_base(CTX, None, eval_data, None)
+
+    assert isinstance(result, MetricEvaluatorResult)
+    assert result.best_idx == 1
+    assert result.best_score.score == pytest.approx(7.0)
+    assert result.best_engine_params is params_list[1]
+    assert result.metric_header == "DSIdMetric"
+    assert "Best Params Index: 1" in result.to_one_liner()
+
+    # best.json is a loadable variant snapshot (saveEngineJson :190-213)
+    variant = json.loads(open(out).read())
+    assert variant["datasource"]["params"]["id"] == 7
+    # and it round-trips through the engine's variant parser
+    ep = engine.engine_params_from_variant(variant)
+    assert ep.data_source_params[1].id == 7
+
+
+def test_metric_evaluator_tie_keeps_first():
+    engine = grid_engine()
+    params_list = grid_params([4, 4])
+    eval_data = engine.batch_eval(CTX, params_list)
+    result = MetricEvaluator(DSIdMetric()).evaluate_base(
+        CTX, None, eval_data, None)
+    assert result.best_idx == 0
+    assert result.output_path is None
+
+
+def test_metric_evaluator_other_metrics():
+    engine = grid_engine()
+    eval_data = engine.batch_eval(CTX, grid_params([2]))
+    result = MetricEvaluator(DSIdMetric(), [ZeroMetric()]).evaluate_base(
+        CTX, None, eval_data, None)
+    assert result.other_metric_headers == ["ZeroMetric"]
+    assert list(result.best_score.other_scores) == [0.0]
+    parsed = json.loads(result.to_json())
+    assert parsed["bestScore"]["score"] == pytest.approx(2.0)
+    assert "<table>" in result.to_html()
+
+
+# ---------------------------------------------------------------------------
+# Evaluation / EngineParamsGenerator (Evaluation.scala, EngineParamsGenerator.scala)
+# ---------------------------------------------------------------------------
+
+def test_evaluation_engine_metric_implies_best_json():
+    ev = Evaluation()
+    ev.engine_metric = (grid_engine(), DSIdMetric())
+    engine, evaluator = ev.engine_evaluator
+    assert isinstance(evaluator, MetricEvaluator)
+    assert evaluator.output_path == "best.json"
+
+
+def test_evaluation_set_once():
+    ev = Evaluation()
+    ev.engine_metrics = (grid_engine(), DSIdMetric(), [ZeroMetric()])
+    assert ev.evaluator.output_path is None
+    with pytest.raises(AssertionError):
+        ev.engine_metric = (grid_engine(), DSIdMetric())
+
+
+def test_evaluation_unset_raises():
+    with pytest.raises(AssertionError):
+        Evaluation().engine
+
+
+def test_engine_params_generator_set_once():
+    gen = EngineParamsGenerator()
+    with pytest.raises(AssertionError):
+        gen.engine_params_list
+    gen.engine_params_list = grid_params([1, 2])
+    assert len(gen.engine_params_list) == 2
+    with pytest.raises(AssertionError):
+        gen.engine_params_list = []
+
+
+# ---------------------------------------------------------------------------
+# FastEvalEngine memoization (FastEvalEngine.scala:50-342)
+# ---------------------------------------------------------------------------
+
+class CountingDataSource(DataSource0):
+    reads = 0
+
+    def read_eval(self, ctx):
+        type(self).reads += 1
+        return super().read_eval(ctx)
+
+
+class CountingPreparator(Preparator0):
+    prepares = 0
+
+    def prepare(self, ctx, td):
+        type(self).prepares += 1
+        return super().prepare(ctx, td)
+
+
+class CountingAlgo(PAlgo0):
+    trains = 0
+
+    def train(self, ctx, pd):
+        type(self).trains += 1
+        return super().train(ctx, pd)
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    CountingDataSource.reads = 0
+    CountingPreparator.prepares = 0
+    CountingAlgo.trains = 0
+    yield
+
+
+def fast_engine():
+    return FastEvalEngine(CountingDataSource, CountingPreparator,
+                          {"": CountingAlgo}, Serving0)
+
+
+def fe_params(ds=1, prep=2, algo=3, serving=9):
+    return EngineParams(
+        data_source_params=("", IdParams(ds, en=2, qn=2)),
+        preparator_params=("", IdParams(prep)),
+        algorithm_params_list=[("", IdParams(algo))],
+        serving_params=("", IdParams(serving)),
+    )
+
+
+def test_fast_eval_shares_datasource_and_preparator():
+    """Varying only algo params: DS reads once, preparator runs once per
+    eval set, algorithms once per distinct algo params."""
+    engine = fast_engine()
+    result = engine.batch_eval(
+        CTX, [fe_params(algo=3), fe_params(algo=4), fe_params(algo=3)])
+    assert len(result) == 3
+    assert CountingDataSource.reads == 1
+    assert CountingPreparator.prepares == 2      # 2 eval sets, one pass
+    assert CountingAlgo.trains == 4              # 2 algo params x 2 eval sets
+
+
+def test_fast_eval_shares_algorithms_across_serving():
+    engine = fast_engine()
+    engine.batch_eval(
+        CTX, [fe_params(serving=1), fe_params(serving=2)])
+    assert CountingDataSource.reads == 1
+    assert CountingAlgo.trains == 2              # 1 algo params x 2 eval sets
+
+
+def test_fast_eval_distinct_datasource_recomputes():
+    engine = fast_engine()
+    engine.batch_eval(CTX, [fe_params(ds=1), fe_params(ds=2)])
+    assert CountingDataSource.reads == 2
+    assert CountingPreparator.prepares == 4
+
+
+def test_fast_eval_output_matches_slow_engine():
+    """FastEvalEngine must produce the same (Q, P, A) stream as Engine.eval
+    modulo the documented no-supplement quirk (none of these fixtures
+    supplement)."""
+    slow = Engine(DataSource0, Preparator0, {"": PAlgo0}, Serving0)
+    fast = FastEvalEngine(DataSource0, Preparator0, {"": PAlgo0}, Serving0)
+    ep = EngineParams(
+        data_source_params=("", IdParams(1, en=2, qn=3)),
+        preparator_params=("", IdParams(2)),
+        algorithm_params_list=[("", IdParams(3))],
+        serving_params=("", IdParams(9)),
+    )
+    slow_out = slow.eval(CTX, ep)
+    fast_out = fast.eval(CTX, ep)
+    assert len(slow_out) == len(fast_out) == 2
+    for (ei_s, qpa_s), (ei_f, qpa_f) in zip(slow_out, fast_out):
+        assert ei_s == ei_f
+        assert [(q, a) for q, _p, a in qpa_s] == [
+            (q, a) for q, _p, a in qpa_f]
+        assert [p.id for _q, p, _a in qpa_s] == [
+            p.id for _q, p, _a in qpa_f]
+
+
+def test_fast_eval_single_eval_unwraps():
+    engine = fast_engine()
+    out = engine.eval(CTX, fe_params())
+    assert len(out) == 2  # en=2 eval sets
+
+
+# ---------------------------------------------------------------------------
+# tune -> train handoff (best.json engineFactory round trip)
+# ---------------------------------------------------------------------------
+
+class HandoffEval(Evaluation):
+    """Module-level Evaluation so load_engine_factory can resolve it."""
+
+    def __init__(self):
+        super().__init__()
+        self.engine_evaluator = (grid_engine(), MetricEvaluator(DSIdMetric()))
+
+
+def test_best_json_engine_factory_is_trainable(tmp_path, mem_storage):
+    """best.json's engineFactory must load through create_workflow and
+    train (the advertised tune-then-train handoff)."""
+    from predictionio_tpu.workflow import WorkflowConfig, create_workflow
+
+    ev = HandoffEval()
+    out = str(tmp_path / "best.json")
+    evaluator = MetricEvaluator(DSIdMetric(), output_path=out)
+    eval_data = ev.engine.batch_eval(CTX, grid_params([2, 6]))
+    evaluator.evaluate_base(CTX, ev, eval_data, None)
+
+    variant = json.loads(open(out).read())
+    assert variant["engineFactory"] == f"{__name__}:HandoffEval"
+    iid = create_workflow(
+        WorkflowConfig(engine_factory=variant["engineFactory"]),
+        variant=variant)
+    assert iid is not None
+
+
+def test_metric_evaluator_rejects_empty_grid():
+    with pytest.raises(ValueError, match="at least one"):
+        MetricEvaluator(DSIdMetric()).evaluate_base(CTX, None, [], None)

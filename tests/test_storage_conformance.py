@@ -296,6 +296,41 @@ class TestSqliteConcurrency:
         assert apps.get(aid).name == "alive"
 
 
+class TestScanSnapshot:
+    """find() must give snapshot semantics: writing while iterating must
+    not change (or break) the rows the scan yields."""
+
+    @pytest.mark.parametrize("kind", ["memory_backend", "sqlite_file",
+                                      "sqlite_memory"])
+    def test_write_while_iterating(self, kind, tmp_path):
+        from predictionio_tpu.data.storage.memory import MemLEvents
+        from predictionio_tpu.data.storage.sqlite import (
+            SqliteClient, SqliteLEvents)
+        if kind == "memory_backend":
+            le = MemLEvents({})
+        elif kind == "sqlite_file":
+            le = SqliteLEvents({"path": str(tmp_path / "snap.db")})
+        else:
+            SqliteClient.shutdown_all()
+            le = SqliteLEvents({})
+        le.init(APP)
+        for i in range(20):
+            le.insert(mk(i, eid=f"u{i}"), APP)
+        seen = []
+        for ev in le.find(APP):
+            seen.append(ev.entity_id)
+            # interleaved write through the same DAO/connection
+            le.insert(Event(
+                event="rate", entity_type="user",
+                entity_id=f"new{len(seen)}",
+                event_time=dt.datetime(2020, 1, 2, tzinfo=UTC)
+                + dt.timedelta(seconds=len(seen))), APP)
+        assert seen == [f"u{i}" for i in range(20)]
+        assert len(list(le.find(APP))) == 40
+        if kind != "memory_backend":
+            SqliteClient.shutdown_all()
+
+
 class TestRegistryAndFacades:
     def test_env_config_parsing(self, monkeypatch):
         from predictionio_tpu.data.storage import StorageConfig
