@@ -1,0 +1,454 @@
+"""Event-collection REST server (:7070).
+
+Parity target: ``data/.../api/EventServer.scala:90-632`` — same routes,
+same status codes, same JSON shapes:
+
+- ``GET /``                        → ``{"status": "alive"}``
+- ``POST /events.json``            → 201 ``{"eventId": ...}``
+- ``GET /events.json``             → filtered query, default limit 20
+- ``GET|DELETE /events/<id>.json`` → single-event fetch/delete
+- ``POST /batch/events.json``      → ≤50 events, per-item statuses
+- ``GET /stats.json``              → counters (only with ``stats=True``)
+- ``GET /plugins.json`` + ``GET /plugins/<type>/<name>/...``
+- ``POST|GET /webhooks/<name>.json|.form``
+
+Auth: ``accessKey`` query param or Basic ``Authorization`` header
+(EventServer.scala:90-128); optional ``channel`` query param resolves a
+channel name to its ID. The spray/akka stack is replaced by a
+thread-per-request stdlib HTTP server: the storage DAOs are blocking and
+thread-safe, so threads are the idiomatic host-side concurrency here
+(the TPU is never on this path).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import logging
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.api.plugins import EventInfo, EventServerPluginContext
+from predictionio_tpu.data.api.stats import StatsKeeper
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    validate_event,
+)
+from predictionio_tpu.data.storage.base import UNSET
+
+logger = logging.getLogger("pio.eventserver")
+
+MAX_EVENTS_PER_BATCH = 50  # EventServer.scala:68
+DEFAULT_QUERY_LIMIT = 20   # EventServer.scala:352
+
+
+@dataclasses.dataclass
+class EventServerConfig:
+    """EventServerConfig (EventServer.scala:572-576)."""
+    ip: str = "0.0.0.0"
+    port: int = 7070
+    stats: bool = False
+
+
+@dataclasses.dataclass
+class AuthData:
+    """Resolved access-key auth (EventServer.scala:87)."""
+    app_id: int
+    channel_id: Optional[int]
+    events: Sequence[str]
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, payload: Dict[str, Any]):
+        super().__init__(payload.get("message", ""))
+        self.status = status
+        self.payload = payload
+
+
+class EventServer:
+    """The daemon. ``start()`` binds and serves on a background thread."""
+
+    def __init__(self, config: EventServerConfig = EventServerConfig(),
+                 plugin_context: Optional[EventServerPluginContext] = None,
+                 reg: Optional[storage.StorageRegistry] = None):
+        self.config = config
+        self.registry = reg or storage.registry()
+        self.event_client = self.registry.get_levents()
+        self.access_keys_client = self.registry.get_metadata_access_keys()
+        self.channels_client = self.registry.get_metadata_channels()
+        self.stats_keeper = StatsKeeper() if config.stats else None
+        self.plugin_context = plugin_context or EventServerPluginContext()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "EventServer":
+        server = self
+
+        class Handler(_EventHandler):
+            event_server = server
+
+        self._httpd = ThreadingHTTPServer((self.config.ip, self.config.port),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pio-eventserver",
+            daemon=True)
+        self._thread.start()
+        logger.info("Event server started on %s:%d", *self.address)
+        return self
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        assert self._httpd is not None, "server not started"
+        host, port = self._httpd.server_address[:2]
+        return str(host), int(port)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def serve_forever(self) -> None:
+        if self._httpd is None:
+            self.start()
+        assert self._thread is not None
+        self._thread.join()
+
+    # -- auth (EventServer.scala:90-128) -----------------------------------
+    def authenticate(self, query: Dict[str, List[str]],
+                     headers) -> AuthData:
+        key_param = _first(query, "accessKey")
+        channel_param = _first(query, "channel")
+        if key_param is not None:
+            k = self.access_keys_client.get(key_param)
+            if k is None:
+                raise _HttpError(401, {"message": "Invalid accessKey."})
+            if channel_param is not None:
+                channel_map = {
+                    c.name: c.id
+                    for c in self.channels_client.get_by_appid(k.appid)
+                }
+                if channel_param not in channel_map:
+                    raise _HttpError(
+                        401, {"message": f"Invalid channel '{channel_param}'."})
+                return AuthData(k.appid, channel_map[channel_param], k.events)
+            return AuthData(k.appid, None, k.events)
+        auth_header = headers.get("Authorization")
+        if auth_header and auth_header.startswith("Basic "):
+            try:
+                decoded = base64.b64decode(
+                    auth_header[len("Basic "):]).decode("utf-8")
+            except Exception:
+                raise _HttpError(401, {"message": "Invalid accessKey."})
+            app_access_key = decoded.strip().split(":")[0]
+            k = self.access_keys_client.get(app_access_key)
+            if k is None:
+                raise _HttpError(401, {"message": "Invalid accessKey."})
+            return AuthData(k.appid, None, k.events)
+        raise _HttpError(401, {"message": "Missing accessKey."})
+
+    # -- route logic -------------------------------------------------------
+    def _bookkeep(self, app_id: int, status: int, event: Event) -> None:
+        if self.stats_keeper is not None:
+            self.stats_keeper.bookkeeping(app_id, status, event)
+
+    def _insert_one(self, event: Event, auth: AuthData) -> Tuple[int, Dict]:
+        """Single-event insert path (EventServer.scala:259-299)."""
+        if auth.events and event.event not in auth.events:
+            self._bookkeep(auth.app_id, 403, event)
+            return 403, {"message": f"{event.event} events are not allowed"}
+        info = EventInfo(auth.app_id, auth.channel_id, event)
+        for blocker in self.plugin_context.input_blockers.values():
+            try:
+                blocker.process(info, self.plugin_context)
+            except ValueError as e:
+                self._bookkeep(auth.app_id, 403, event)
+                return 403, {"message": str(e)}
+        event_id = self.event_client.insert(event, auth.app_id,
+                                            auth.channel_id)
+        for sniffer in self.plugin_context.input_sniffers.values():
+            try:
+                sniffer.process(info, self.plugin_context)
+            except Exception:
+                logger.exception("input sniffer failed")
+        self._bookkeep(auth.app_id, 201, event)
+        return 201, {"eventId": str(event_id)}
+
+    def post_events(self, auth: AuthData, body: bytes) -> Tuple[int, Any]:
+        event = _parse_event(body)
+        return self._insert_one(event, auth)
+
+    def post_batch(self, auth: AuthData, body: bytes) -> Tuple[int, Any]:
+        """Batch insert, per-item status (EventServer.scala:374-440)."""
+        try:
+            items = json.loads(body.decode("utf-8"))
+            if not isinstance(items, list):
+                raise ValueError("batch body must be a JSON array")
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError) as e:
+            return 400, {"message": f"{e}"}
+        if len(items) > MAX_EVENTS_PER_BATCH:
+            return 400, {"message":
+                         "Batch request must have less than or equal to "
+                         f"{MAX_EVENTS_PER_BATCH} events"}
+        results = []
+        for item in items:
+            try:
+                event = _parse_event_dict(item)
+            except EventValidationError as e:
+                results.append({"status": 400, "message": str(e)})
+                continue
+            try:
+                status, payload = self._insert_one(event, auth)
+            except Exception as e:  # per-item isolation (scala :404-408)
+                results.append({"status": 500, "message": str(e)})
+                continue
+            entry: Dict[str, Any] = {"status": status}
+            entry.update(payload)
+            results.append(entry)
+        return 200, results
+
+    def get_events(self, auth: AuthData,
+                   query: Dict[str, List[str]]) -> Tuple[int, Any]:
+        """Filtered query (EventServer.scala:300-372)."""
+        reversed_ = _first(query, "reversed") in ("true", "True", "1")
+        entity_type = _first(query, "entityType")
+        entity_id = _first(query, "entityId")
+        if reversed_ and (entity_type is None or entity_id is None):
+            return 400, {"message":
+                         "the parameter reversed can only be used with both "
+                         "entityType and entityId specified."}
+        try:
+            from predictionio_tpu.data.event import _parse_time
+            start_time = _parse_time(_first(query, "startTime"))
+            until_time = _parse_time(_first(query, "untilTime"))
+            limit_s = _first(query, "limit")
+            limit = int(limit_s) if limit_s is not None else DEFAULT_QUERY_LIMIT
+        except (EventValidationError, ValueError) as e:
+            return 400, {"message": f"{e}"}
+        event_name = _first(query, "event")
+        tet = _first(query, "targetEntityType")
+        tei = _first(query, "targetEntityId")
+        events = list(self.event_client.find(
+            app_id=auth.app_id,
+            channel_id=auth.channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=[event_name] if event_name else None,
+            target_entity_type=tet if tet is not None else UNSET,
+            target_entity_id=tei if tei is not None else UNSET,
+            limit=limit,
+            reversed=reversed_,
+        ))
+        if not events:
+            return 404, {"message": "Not Found"}
+        return 200, [e.to_dict() for e in events]
+
+    def get_event(self, auth: AuthData, event_id: str) -> Tuple[int, Any]:
+        event = self.event_client.get(event_id, auth.app_id, auth.channel_id)
+        if event is None:
+            return 404, {"message": "Not Found"}
+        return 200, event.to_dict()
+
+    def delete_event(self, auth: AuthData, event_id: str) -> Tuple[int, Any]:
+        found = self.event_client.delete(event_id, auth.app_id,
+                                         auth.channel_id)
+        if found:
+            return 200, {"message": "Found"}
+        return 404, {"message": "Not Found"}
+
+    def get_stats(self, auth: AuthData) -> Tuple[int, Any]:
+        if self.stats_keeper is None:
+            return 404, {"message": "To see stats, launch Event Server with "
+                                    "--stats argument."}
+        return 200, self.stats_keeper.get(auth.app_id)
+
+    def post_webhooks(self, auth: AuthData, name: str, form: bool,
+                      body: bytes,
+                      content_type: str) -> Tuple[int, Any]:
+        """Webhook ingestion (api/Webhooks.scala:44-151)."""
+        from predictionio_tpu.data import webhooks
+
+        if form:
+            connector = webhooks.FORM_CONNECTORS.get(name)
+        else:
+            connector = webhooks.JSON_CONNECTORS.get(name)
+        if connector is None:
+            return 404, {"message":
+                         f"webhooks connection for {name} is not supported."}
+        try:
+            if form:
+                fields = dict(urllib.parse.parse_qsl(body.decode("utf-8")))
+                event_json = connector.to_event_json(fields)
+            else:
+                data = json.loads(body.decode("utf-8"))
+                if not isinstance(data, dict):
+                    raise webhooks.ConnectorException(
+                        "webhook body must be a JSON object")
+                event_json = connector.to_event_json(data)
+            event = _parse_event_dict(event_json)
+        except (webhooks.ConnectorException, EventValidationError,
+                json.JSONDecodeError, UnicodeDecodeError) as e:
+            return 400, {"message": f"{e}"}
+        event_id = self.event_client.insert(event, auth.app_id,
+                                            auth.channel_id)
+        self._bookkeep(auth.app_id, 201, event)
+        return 201, {"eventId": str(event_id)}
+
+    def get_webhooks(self, auth: AuthData, name: str,
+                     form: bool) -> Tuple[int, Any]:
+        from predictionio_tpu.data import webhooks
+
+        reg = webhooks.FORM_CONNECTORS if form else webhooks.JSON_CONNECTORS
+        if name in reg:
+            return 200, {"message": "Ok"}
+        return 404, {"message":
+                     f"webhooks connection for {name} is not supported."}
+
+
+def _first(query: Dict[str, List[str]], key: str) -> Optional[str]:
+    vals = query.get(key)
+    return vals[0] if vals else None
+
+
+def _parse_event_dict(d: Any) -> Event:
+    if not isinstance(d, dict):
+        raise EventValidationError("event JSON must be an object")
+    try:
+        event = Event.from_dict(d)
+    except EventValidationError:
+        raise
+    except (TypeError, ValueError, AttributeError) as e:
+        # malformed field types (tags: 5, properties: "x", ...) are client
+        # errors, same contract as validation failures
+        raise EventValidationError(str(e)) from e
+    validate_event(event)
+    return event
+
+
+def _parse_event(body: bytes) -> Event:
+    try:
+        d = json.loads(body.decode("utf-8"))
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise _HttpError(400, {"message": f"invalid JSON: {e}"})
+    try:
+        return _parse_event_dict(d)
+    except EventValidationError as e:
+        raise _HttpError(400, {"message": str(e)})
+
+
+class _EventHandler(BaseHTTPRequestHandler):
+    """Request → route dispatch. One instance per request (threaded)."""
+
+    event_server: EventServer  # injected by EventServer.start
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+    def _respond(self, status: int, payload: Any) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        return self._request_body
+
+    def _dispatch(self, method: str) -> None:
+        srv = self.event_server
+        parsed = urllib.parse.urlsplit(self.path)
+        path = parsed.path.rstrip("/") or "/"
+        query = urllib.parse.parse_qs(parsed.query)
+        # Drain the request body up-front: every exit path (401, 404, ...)
+        # must leave rfile at a message boundary or HTTP/1.1 keep-alive
+        # clients would read garbage on the next pipelined request.
+        length = int(self.headers.get("Content-Length") or 0)
+        self._request_body = self.rfile.read(length) if length else b""
+        try:
+            if path == "/" and method == "GET":
+                self._respond(200, {"status": "alive"})
+                return
+            if path == "/plugins.json" and method == "GET":
+                self._respond(200, srv.plugin_context.describe())
+                return
+            auth = srv.authenticate(query, self.headers)
+            status, payload = self._route(srv, method, path, query, auth)
+            self._respond(status, payload)
+        except _HttpError as e:
+            self._respond(e.status, e.payload)
+        except Exception as e:
+            logger.exception("unhandled error on %s %s", method, path)
+            self._respond(500, {"message": str(e)})
+
+    def _route(self, srv: EventServer, method: str, path: str,
+               query: Dict[str, List[str]], auth: AuthData) -> Tuple[int, Any]:
+        if path == "/events.json":
+            if method == "POST":
+                return srv.post_events(auth, self._body())
+            if method == "GET":
+                return srv.get_events(auth, query)
+        elif path == "/batch/events.json":
+            if method == "POST":
+                return srv.post_batch(auth, self._body())
+        elif path == "/stats.json" and method == "GET":
+            return srv.get_stats(auth)
+        elif path.startswith("/events/") and path.endswith(".json"):
+            event_id = path[len("/events/"):-len(".json")]
+            if method == "GET":
+                return srv.get_event(auth, event_id)
+            if method == "DELETE":
+                return srv.delete_event(auth, event_id)
+        elif path.startswith("/webhooks/"):
+            rest = path[len("/webhooks/"):]
+            form = rest.endswith(".form")
+            if rest.endswith(".json") or form:
+                name = rest.rsplit(".", 1)[0]
+                if method == "POST":
+                    return srv.post_webhooks(
+                        auth, name, form, self._body(),
+                        self.headers.get("Content-Type", ""))
+                if method == "GET":
+                    return srv.get_webhooks(auth, name, form)
+        elif path.startswith("/plugins/") and method == "GET":
+            segments = [s for s in path.split("/") if s][1:]
+            if len(segments) >= 2:
+                ptype, pname, *args = segments
+                ctx = srv.plugin_context
+                reg = (ctx.input_blockers if ptype == "inputblocker"
+                       else ctx.input_sniffers)
+                plugin = reg.get(pname)
+                if plugin is None:
+                    return 404, {"message": f"plugin {pname} not found"}
+                return 200, json.loads(
+                    plugin.handle_rest(auth.app_id, auth.channel_id, args))
+        return 404, {"message": "Not Found"}
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+
+def create_event_server(config: EventServerConfig = EventServerConfig(),
+                        **kwargs) -> EventServer:
+    """createEventServer parity (EventServer.scala:610-632)."""
+    return EventServer(config, **kwargs)

@@ -131,11 +131,6 @@ def run_evaluation(
     try:
         eval_data = engine.batch_eval(ctx, list(engine_params_list), params)
         result = evaluator.evaluate_base(ctx, evaluation, eval_data, params)
-    except Exception:
-        evaluation_instances.update(dataclasses.replace(
-            instance, status="FAILED", end_time=_now()))
-        raise
-    else:
         if result.no_save:
             logger.info("Result not inserted into database: %r", result)
         else:
@@ -148,5 +143,9 @@ def run_evaluation(
                 evaluator_results_json=result.to_json(),
             ))
         return result
+    except Exception:
+        evaluation_instances.update(dataclasses.replace(
+            instance, status="FAILED", end_time=_now()))
+        raise
     finally:
         ctx.stop()

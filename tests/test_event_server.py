@@ -1,0 +1,338 @@
+"""HTTP-level event server tests.
+
+Mirrors the reference's ``EventServiceSpec``/``SegmentIOAuthSpec``
+(``data/src/test/.../api/``): auth failure, validation failure, batch cap,
+stats counters, webhooks — here against a live server on an ephemeral port.
+"""
+
+import base64
+import json
+import urllib.parse
+
+import pytest
+
+from predictionio_tpu.data.api import (
+    EventServer,
+    EventServerConfig,
+    EventServerPluginContext,
+)
+from predictionio_tpu.data.api.plugins import INPUT_BLOCKER, EventServerPlugin
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+
+import http.client
+
+
+APP_ID = 7
+KEY = "testkey"
+RATE_ONLY_KEY = "rateonly"
+
+
+@pytest.fixture
+def server(mem_storage):
+    apps = mem_storage.get_metadata_apps()
+    apps.insert(App(id=APP_ID, name="testapp"))
+    keys = mem_storage.get_metadata_access_keys()
+    keys.insert(AccessKey(key=KEY, appid=APP_ID))
+    keys.insert(AccessKey(key=RATE_ONLY_KEY, appid=APP_ID, events=("rate",)))
+    channels = mem_storage.get_metadata_channels()
+    channels.insert(Channel(id=0, name="mychan", appid=APP_ID))
+
+    srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0, stats=True),
+                      reg=mem_storage)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def request(srv, method, path, body=None, params=None, headers=None):
+    host, port = srv.address
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    if params:
+        path = path + "?" + urllib.parse.urlencode(params)
+    payload = None
+    hdrs = dict(headers or {})
+    if body is not None:
+        payload = body if isinstance(body, (bytes, str)) else json.dumps(body)
+        hdrs.setdefault("Content-Type", "application/json")
+    conn.request(method, path, body=payload, headers=hdrs)
+    resp = conn.getresponse()
+    data = json.loads(resp.read().decode("utf-8"))
+    conn.close()
+    return resp.status, data
+
+
+def post_event(srv, event, key=KEY, **params):
+    return request(srv, "POST", "/events.json", body=event,
+                   params={"accessKey": key, **params})
+
+
+RATE = {"event": "rate", "entityType": "user", "entityId": "u1",
+        "targetEntityType": "item", "targetEntityId": "i1",
+        "properties": {"rating": 4.0}}
+
+
+def test_root_alive(server):
+    status, data = request(server, "GET", "/")
+    assert (status, data) == (200, {"status": "alive"})
+
+
+def test_auth_missing_and_invalid(server):
+    status, data = request(server, "POST", "/events.json", body=RATE)
+    assert status == 401
+    status, _ = post_event(server, RATE, key="nope")
+    assert status == 401
+
+
+def test_basic_auth_header(server):
+    cred = base64.b64encode(f"{KEY}:".encode()).decode()
+    status, data = request(server, "POST", "/events.json", body=RATE,
+                           headers={"Authorization": f"Basic {cred}"})
+    assert status == 201 and "eventId" in data
+
+
+def test_post_get_delete_roundtrip(server):
+    status, data = post_event(server, RATE)
+    assert status == 201
+    eid = data["eventId"]
+
+    status, got = request(server, "GET", f"/events/{eid}.json",
+                          params={"accessKey": KEY})
+    assert status == 200
+    assert got["event"] == "rate" and got["entityId"] == "u1"
+    assert got["properties"] == {"rating": 4.0}
+
+    status, msg = request(server, "DELETE", f"/events/{eid}.json",
+                          params={"accessKey": KEY})
+    assert (status, msg) == (200, {"message": "Found"})
+    status, msg = request(server, "DELETE", f"/events/{eid}.json",
+                          params={"accessKey": KEY})
+    assert status == 404
+
+
+def test_validation_failure_400(server):
+    bad = dict(RATE, entityId="")
+    status, data = post_event(server, bad)
+    assert status == 400
+    # $unset without properties (Event.scala:122-125)
+    status, data = post_event(
+        server, {"event": "$unset", "entityType": "user", "entityId": "u1"})
+    assert status == 400
+
+
+def test_event_whitelist_403(server):
+    status, _ = post_event(server, RATE, key=RATE_ONLY_KEY)
+    assert status == 201
+    buy = dict(RATE, event="buy")
+    status, data = post_event(server, buy, key=RATE_ONLY_KEY)
+    assert status == 403
+    assert data["message"] == "buy events are not allowed"
+
+
+def test_channel_isolation(server):
+    status, _ = post_event(server, RATE, channel="mychan")
+    assert status == 201
+    # default channel has no events
+    status, _ = request(server, "GET", "/events.json",
+                        params={"accessKey": KEY})
+    assert status == 404
+    # named channel has one
+    status, events = request(server, "GET", "/events.json",
+                             params={"accessKey": KEY, "channel": "mychan"})
+    assert status == 200 and len(events) == 1
+    # unknown channel name rejected
+    status, _ = post_event(server, RATE, channel="nochan")
+    assert status == 401
+
+
+def test_get_events_filters(server):
+    for i, (name, uid) in enumerate(
+            [("rate", "u1"), ("rate", "u2"), ("buy", "u1")]):
+        e = {"event": name, "entityType": "user", "entityId": uid,
+             "targetEntityType": "item", "targetEntityId": "i1",
+             "eventTime": f"2020-01-01T00:00:0{i}+00:00"}
+        assert post_event(server, e)[0] == 201
+
+    status, events = request(server, "GET", "/events.json",
+                             params={"accessKey": KEY, "event": "rate"})
+    assert status == 200 and len(events) == 2
+
+    status, events = request(
+        server, "GET", "/events.json",
+        params={"accessKey": KEY, "entityType": "user", "entityId": "u1",
+                "reversed": "true"})
+    assert status == 200
+    assert [e["event"] for e in events] == ["buy", "rate"]
+
+    status, events = request(server, "GET", "/events.json",
+                             params={"accessKey": KEY, "limit": "1"})
+    assert status == 200 and len(events) == 1
+
+    # reversed requires entity filters (EventServer.scala:328-331)
+    status, _ = request(server, "GET", "/events.json",
+                        params={"accessKey": KEY, "reversed": "true"})
+    assert status == 400
+
+
+def test_batch(server):
+    events = [RATE, dict(RATE, entityId=""), dict(RATE, event="buy")]
+    status, results = request(server, "POST", "/batch/events.json",
+                              body=events, params={"accessKey": KEY})
+    assert status == 200
+    assert [r["status"] for r in results] == [201, 400, 201]
+    assert "eventId" in results[0]
+
+    status, results = request(server, "POST", "/batch/events.json",
+                              body=events,
+                              params={"accessKey": RATE_ONLY_KEY})
+    assert [r["status"] for r in results] == [201, 400, 403]
+
+    status, data = request(server, "POST", "/batch/events.json",
+                           body=[RATE] * 51, params={"accessKey": KEY})
+    assert status == 400
+    assert "less than or equal to 50" in data["message"]
+
+
+def test_stats(server):
+    post_event(server, RATE)
+    post_event(server, dict(RATE, event="buy"))
+    status, stats = request(server, "GET", "/stats.json",
+                            params={"accessKey": KEY})
+    assert status == 200
+    basic = {b["event"]: b["count"] for b in stats["longLive"]["basic"]}
+    assert basic == {"rate": 1, "buy": 1}
+    assert stats["longLive"]["statusCode"] == [{"status": 201, "count": 2}]
+
+
+def test_stats_disabled_404(mem_storage):
+    mem_storage.get_metadata_access_keys().insert(
+        AccessKey(key=KEY, appid=APP_ID))
+    srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0, stats=False),
+                      reg=mem_storage).start()
+    try:
+        status, data = request(srv, "GET", "/stats.json",
+                               params={"accessKey": KEY})
+        assert status == 404 and "--stats" in data["message"]
+    finally:
+        srv.stop()
+
+
+def test_webhooks_segmentio(server):
+    payload = {
+        "version": "2",
+        "type": "track",
+        "userId": "user123",
+        "event": "signup",
+        "timestamp": "2020-05-01T12:00:00Z",
+        "properties": {"plan": "pro"},
+    }
+    status, data = request(server, "POST", "/webhooks/segmentio.json",
+                           body=payload, params={"accessKey": KEY})
+    assert status == 201 and "eventId" in data
+
+    status, got = request(server, "GET", f"/events/{data['eventId']}.json",
+                          params={"accessKey": KEY})
+    assert got["event"] == "track"
+    assert got["entityType"] == "user" and got["entityId"] == "user123"
+    assert got["properties"]["event"] == "signup"
+
+    # existence check + unsupported connector
+    status, data = request(server, "GET", "/webhooks/segmentio.json",
+                           params={"accessKey": KEY})
+    assert (status, data) == (200, {"message": "Ok"})
+    status, _ = request(server, "POST", "/webhooks/unknown.json",
+                        body=payload, params={"accessKey": KEY})
+    assert status == 404
+
+
+def test_webhooks_mailchimp_form(server):
+    fields = {
+        "type": "subscribe",
+        "fired_at": "2009-03-26 21:35:57",
+        "data[id]": "8a25ff1d98",
+        "data[list_id]": "a6b5da1054",
+        "data[email]": "api@mailchimp.com",
+        "data[email_type]": "html",
+        "data[merges][EMAIL]": "api@mailchimp.com",
+        "data[merges][FNAME]": "MailChimp",
+        "data[merges][LNAME]": "API",
+        "data[ip_opt]": "10.20.10.30",
+        "data[ip_signup]": "10.20.10.30",
+    }
+    body = urllib.parse.urlencode(fields)
+    status, data = request(
+        server, "POST", "/webhooks/mailchimp.form", body=body,
+        params={"accessKey": KEY},
+        headers={"Content-Type": "application/x-www-form-urlencoded"})
+    assert status == 201
+
+    status, got = request(server, "GET", f"/events/{data['eventId']}.json",
+                          params={"accessKey": KEY})
+    assert got["event"] == "subscribe"
+    assert got["targetEntityId"] == "a6b5da1054"
+    assert got["properties"]["merges"]["FNAME"] == "MailChimp"
+    assert got["eventTime"].startswith("2009-03-26T21:35:57")
+
+
+def test_keepalive_after_auth_failure(server):
+    """A rejected POST must drain its body so the next request on the same
+    HTTP/1.1 connection still parses (regression: pipelined GET got 501)."""
+    host, port = server.address
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    body = json.dumps(RATE)
+    conn.request("POST", "/events.json?accessKey=WRONG", body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 401
+    resp.read()
+    conn.request("GET", "/")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert json.loads(resp.read()) == {"status": "alive"}
+    conn.close()
+
+
+def test_malformed_field_types_are_client_errors(server):
+    # tags must be a list; a scalar raises TypeError inside Event.from_dict
+    bad = dict(RATE, tags=5)
+    status, _ = post_event(server, bad)
+    assert status == 400
+    # one malformed item must not 500 the whole batch
+    status, results = request(server, "POST", "/batch/events.json",
+                              body=[RATE, bad], params={"accessKey": KEY})
+    assert status == 200
+    assert [r["status"] for r in results] == [201, 400]
+
+
+def test_stats_counts_forbidden(server):
+    post_event(server, dict(RATE, event="buy"), key=RATE_ONLY_KEY)
+    status, stats = request(server, "GET", "/stats.json",
+                            params={"accessKey": KEY})
+    assert status == 200
+    assert {"status": 403, "count": 1} in stats["longLive"]["statusCode"]
+
+
+class RejectAllBlocker(EventServerPlugin):
+    plugin_name = "rejectall"
+    plugin_description = "rejects every event"
+    plugin_type = INPUT_BLOCKER
+
+    def process(self, event_info, context):
+        raise ValueError("blocked by policy")
+
+
+def test_plugins(mem_storage):
+    mem_storage.get_metadata_access_keys().insert(
+        AccessKey(key=KEY, appid=APP_ID))
+    ctx = EventServerPluginContext([RejectAllBlocker()])
+    srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                      plugin_context=ctx, reg=mem_storage).start()
+    try:
+        status, data = request(srv, "GET", "/plugins.json")
+        assert status == 200
+        assert "rejectall" in data["plugins"]["inputblockers"]
+
+        status, data = request(srv, "POST", "/events.json", body=RATE,
+                               params={"accessKey": KEY})
+        assert status == 403 and data["message"] == "blocked by policy"
+    finally:
+        srv.stop()

@@ -1,0 +1,122 @@
+"""Webhook connector unit tests.
+
+Mirrors the reference connector specs
+(``data/src/test/.../webhooks/{segmentio,mailchimp}/``): third-party
+payload → event JSON conversion for each message type.
+"""
+
+import pytest
+
+from predictionio_tpu.data.webhooks import ConnectorException
+from predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
+from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
+
+seg = SegmentIOConnector()
+mc = MailChimpConnector()
+
+
+def seg_common(**kw):
+    d = {"version": "2", "timestamp": "2020-05-01T12:00:00Z",
+         "userId": "u1"}
+    d.update(kw)
+    return d
+
+
+def test_segmentio_identify():
+    out = seg.to_event_json(
+        seg_common(type="identify", traits={"email": "a@b.c"}))
+    assert out["event"] == "identify"
+    assert out["entityType"] == "user" and out["entityId"] == "u1"
+    assert out["properties"]["traits"] == {"email": "a@b.c"}
+
+
+def test_segmentio_alias_group_page_screen():
+    out = seg.to_event_json(seg_common(type="alias", previousId="old"))
+    assert out["properties"]["previous_id"] == "old"
+    out = seg.to_event_json(
+        seg_common(type="group", groupId="g1", traits={"size": 3}))
+    assert out["properties"]["group_id"] == "g1"
+    out = seg.to_event_json(seg_common(type="page", name="home"))
+    assert out["properties"]["name"] == "home"
+    out = seg.to_event_json(seg_common(type="screen", name="main"))
+    assert out["event"] == "screen"
+
+
+def test_segmentio_anonymous_id_fallback_and_context():
+    d = seg_common(type="track", event="click",
+                   context={"ip": "1.2.3.4"})
+    del d["userId"]
+    d["anonymousId"] = "anon9"
+    out = seg.to_event_json(d)
+    assert out["entityId"] == "anon9"
+    assert out["properties"]["context"] == {"ip": "1.2.3.4"}
+
+
+def test_segmentio_errors():
+    with pytest.raises(ConnectorException, match="version"):
+        seg.to_event_json({"type": "track", "userId": "u"})
+    with pytest.raises(ConnectorException, match="unknown type"):
+        seg.to_event_json(seg_common(type="bogus"))
+    with pytest.raises(ConnectorException, match="userId"):
+        seg.to_event_json({"version": "2", "type": "track", "event": "e"})
+
+
+MC_BASE = {
+    "fired_at": "2009-03-26 21:40:57",
+    "data[id]": "8a25ff1d98",
+    "data[list_id]": "a6b5da1054",
+    "data[email]": "api@mailchimp.com",
+    "data[email_type]": "html",
+    "data[merges][EMAIL]": "api@mailchimp.com",
+    "data[merges][FNAME]": "MailChimp",
+    "data[merges][LNAME]": "API",
+    "data[ip_opt]": "10.20.10.30",
+}
+
+
+def test_mailchimp_unsubscribe():
+    d = dict(MC_BASE, type="unsubscribe", **{
+        "data[action]": "unsub", "data[reason]": "manual",
+        "data[campaign_id]": "cb398d21d2"})
+    out = mc.to_event_json(d)
+    assert out["event"] == "unsubscribe"
+    assert out["properties"]["action"] == "unsub"
+    assert out["eventTime"] == "2009-03-26T21:40:57+00:00"
+
+
+def test_mailchimp_profile_upemail_cleaned_campaign():
+    out = mc.to_event_json(dict(MC_BASE, type="profile"))
+    assert out["event"] == "profile" and out["entityId"] == "8a25ff1d98"
+
+    out = mc.to_event_json({
+        "type": "upemail", "fired_at": "2009-03-26 22:15:09",
+        "data[list_id]": "a6b5da1054", "data[new_id]": "51da8c3259",
+        "data[new_email]": "new@x.com", "data[old_email]": "old@x.com"})
+    assert out["entityId"] == "51da8c3259"
+    assert out["properties"]["old_email"] == "old@x.com"
+
+    out = mc.to_event_json({
+        "type": "cleaned", "fired_at": "2009-03-26 22:01:00",
+        "data[list_id]": "a6b5da1054", "data[campaign_id]": "4fjk2ma9xd",
+        "data[reason]": "hard", "data[email]": "x@y.z"})
+    assert out["entityType"] == "list" and "targetEntityType" not in out
+
+    out = mc.to_event_json({
+        "type": "campaign", "fired_at": "2009-03-26 21:31:21",
+        "data[id]": "5aa2102003", "data[subject]": "S",
+        "data[status]": "sent", "data[reason]": "",
+        "data[list_id]": "a6b5da1054"})
+    assert out["entityType"] == "campaign"
+
+
+def test_mailchimp_errors():
+    with pytest.raises(ConnectorException, match="required"):
+        mc.to_event_json({"fired_at": "2009-03-26 21:40:57"})
+    with pytest.raises(ConnectorException, match="unknown MailChimp"):
+        mc.to_event_json({"type": "bogus"})
+    with pytest.raises(ConnectorException, match="missing field"):
+        mc.to_event_json({"type": "subscribe",
+                          "fired_at": "2009-03-26 21:40:57"})
+    with pytest.raises(ConnectorException, match="fired_at"):
+        mc.to_event_json(dict(MC_BASE, type="profile",
+                              fired_at="not-a-date"))
