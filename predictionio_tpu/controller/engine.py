@@ -41,6 +41,10 @@ class EngineConfigError(ValueError):
     """Bad engine wiring or variant params."""
 
 
+def _snake_name(name: str) -> str:
+    return "".join("_" + c.lower() if c.isupper() else c for c in name)
+
+
 @dataclasses.dataclass
 class EngineParams:
     """One full parameterization of an engine run
@@ -77,6 +81,15 @@ def params_from_dict(params_cls: Optional[type],
         raise EngineConfigError(
             f"{where}: params_class {params_cls.__name__} must be a dataclass")
     fields = {f.name: f for f in dataclasses.fields(params_cls)}
+    # Reference engine.json uses camelCase ("appName") and raw keywords
+    # ("lambda"); map them onto the dataclass's snake_case/escaped fields.
+    for key in list(data):
+        if key in fields:
+            continue
+        for alt in (_snake_name(key), key + "_", _snake_name(key) + "_"):
+            if alt in fields and alt not in data:
+                data[alt] = data.pop(key)
+                break
     unknown = sorted(set(data) - set(fields))
     if unknown:
         raise EngineConfigError(

@@ -185,6 +185,7 @@ class BaseAlgorithm(AbstractDoer, abc.ABC):
         (BaseAlgorithm.scala:107-112 returns Unit)."""
         return RETRAIN
 
+    @property
     def query_class(self) -> Optional[type]:
         """Query type for JSON extraction at serving time
         (BaseAlgorithm.scala:118-122); None means raw dict queries."""

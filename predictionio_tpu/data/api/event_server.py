@@ -73,10 +73,10 @@ class _HttpError(Exception):
 class EventServer:
     """The daemon. ``start()`` binds and serves on a background thread."""
 
-    def __init__(self, config: EventServerConfig = EventServerConfig(),
+    def __init__(self, config: Optional[EventServerConfig] = None,
                  plugin_context: Optional[EventServerPluginContext] = None,
                  reg: Optional[storage.StorageRegistry] = None):
-        self.config = config
+        self.config = config or EventServerConfig()
         self.registry = reg or storage.registry()
         self.event_client = self.registry.get_levents()
         self.access_keys_client = self.registry.get_metadata_access_keys()
@@ -448,7 +448,7 @@ class _EventHandler(BaseHTTPRequestHandler):
         self._dispatch("DELETE")
 
 
-def create_event_server(config: EventServerConfig = EventServerConfig(),
+def create_event_server(config: Optional[EventServerConfig] = None,
                         **kwargs) -> EventServer:
     """createEventServer parity (EventServer.scala:610-632)."""
     return EventServer(config, **kwargs)

@@ -1,0 +1,15 @@
+"""e2 — framework-independent algorithm library.
+
+Parity: reference ``e2/src/main/scala/io/prediction/e2/`` (Spark-only,
+PIO-independent helpers). Here: numpy/JAX-backed equivalents.
+"""
+
+from predictionio_tpu.e2.engine import (  # noqa: F401
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+    MarkovChain,
+    MarkovChainModel,
+)
+from predictionio_tpu.e2.evaluation import split_data  # noqa: F401

@@ -1,0 +1,16 @@
+"""Classification engine template (Naive Bayes on entity properties)."""
+
+from predictionio_tpu.templates.classification.engine import (  # noqa: F401
+    Accuracy,
+    CategoricalNBAlgorithm,
+    DataSourceParams,
+    EventDataSource,
+    LabeledPoint,
+    NaiveBayesAlgorithm,
+    NaiveBayesModel,
+    NaiveBayesParams,
+    PredictedResult,
+    Query,
+    TrainingData,
+    engine_factory,
+)

@@ -1,0 +1,15 @@
+"""E-commerce recommendation template (ALS + business-rule filters)."""
+
+from predictionio_tpu.templates.ecommercerecommendation.engine import (  # noqa: F401
+    DataSourceParams,
+    ECommAlgorithm,
+    ECommAlgorithmParams,
+    ECommModel,
+    EventDataSource,
+    Item,
+    ItemScore,
+    PredictedResult,
+    Query,
+    TrainingData,
+    engine_factory,
+)

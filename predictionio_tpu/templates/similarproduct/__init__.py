@@ -1,0 +1,16 @@
+"""Similar-product engine template (item-to-item on view events)."""
+
+from predictionio_tpu.templates.similarproduct.engine import (  # noqa: F401
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    DataSourceParams,
+    EventDataSource,
+    Item,
+    ItemScore,
+    PredictedResult,
+    Query,
+    SimilarProductModel,
+    TrainingData,
+    ViewEvent,
+    engine_factory,
+)

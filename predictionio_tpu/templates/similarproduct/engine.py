@@ -1,0 +1,225 @@
+"""Similar-product engine: view events -> ALS item factors -> item-to-item
+cosine similarity.
+
+Capability parity with ``examples/scala-parallel-similarproduct``:
+
+- DataSource reads ``$set`` user/item entities and ``view`` events
+  (``DataSource.scala``); items carry a ``categories`` property
+- ALSAlgorithm aggregates view counts per (user, item), trains implicit
+  ALS, keeps the item ("product") factors
+  (``filterbyyear/src/main/scala/ALSAlgorithm.scala:36-87``)
+- predict: sum of cosine similarities of the query items' factors against
+  every item, filtered by candidate rules — not a query item, category
+  intersection, white/black lists (``ALSAlgorithm.scala:89-135``).
+  The reference's per-item ``.par`` cosine map becomes ONE [Q,R]x[M,R]
+  matmul + reduction (MXU-shaped).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Engine,
+    LFirstServing,
+    P2LAlgorithm,
+    Params,
+    PDataSource,
+    PIdentityPreparator,
+)
+from predictionio_tpu.core.context import ComputeContext
+from predictionio_tpu.data.bimap import BiMap, StringIndexBiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.als import ALSParams, cosine_scores, pad_ratings, train_als
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str
+    channel_name: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Item:
+    categories: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ViewEvent:
+    user: str
+    item: str
+
+
+@dataclasses.dataclass
+class TrainingData:
+    users: Dict[str, None]
+    items: Dict[str, Item]
+    view_events: List[ViewEvent]
+
+    def sanity_check(self) -> None:
+        assert self.view_events, (
+            "viewEvents in PreparedData cannot be empty. Please check if "
+            "DataSource generates TrainingData correctly.")
+        assert self.users, "users in PreparedData cannot be empty."
+        assert self.items, "items in PreparedData cannot be empty."
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    items: Tuple[str, ...] = ()
+    num: int = 10
+    categories: Tuple[str, ...] = ()
+    white_list: Tuple[str, ...] = ()
+    black_list: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: Tuple[ItemScore, ...]
+
+
+class EventDataSource(PDataSource):
+    """$set users/items + view events (similarproduct DataSource.scala)."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        p: DataSourceParams = self.params
+        users = {
+            uid: None
+            for uid in PEventStore.aggregate_properties(
+                app_name=p.app_name, channel_name=p.channel_name,
+                entity_type="user")
+        }
+        items = {
+            iid: Item(categories=tuple(pm.get_opt("categories", list) or ()))
+            for iid, pm in PEventStore.aggregate_properties(
+                app_name=p.app_name, channel_name=p.channel_name,
+                entity_type="item").items()
+        }
+        views = [
+            ViewEvent(user=e.entity_id, item=e.target_entity_id)
+            for e in PEventStore.find(
+                app_name=p.app_name, channel_name=p.channel_name,
+                entity_type="user", event_names=["view"],
+                target_entity_type="item")
+        ]
+        return TrainingData(users, items, views)
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class SimilarProductModel:
+    """Item factors + maps + item metadata (ALSModel analog)."""
+
+    product_features: np.ndarray      # [M, R]
+    item_map: StringIndexBiMap
+    items: Dict[int, Item]            # item index -> metadata
+
+    def sanity_check(self) -> None:
+        assert np.isfinite(self.product_features).all()
+
+
+class ALSAlgorithm(P2LAlgorithm):
+    """Implicit ALS on view counts; keeps productFeatures
+    (ALSAlgorithm.scala:36-87)."""
+
+    params_class = ALSAlgorithmParams
+    query_cls = Query
+
+    def train(self, ctx: ComputeContext,
+              pd: TrainingData) -> SimilarProductModel:
+        p: ALSAlgorithmParams = self.params
+        user_map = BiMap.string_int(pd.users)
+        item_map = BiMap.string_int(pd.items)
+        # aggregate all view events of the same user-item pair
+        counts: Dict[Tuple[int, int], float] = {}
+        for v in pd.view_events:
+            u, i = user_map.get(v.user), item_map.get(v.item)
+            if u is None or i is None:
+                continue  # view of an entity without a $set (scala :59-66)
+            counts[(u, i)] = counts.get((u, i), 0.0) + 1.0
+        if not counts:
+            raise ValueError(
+                "ratings cannot be empty. Please check if your events "
+                "contain valid user and item ID.")
+        keys = np.asarray(list(counts), dtype=np.int64)
+        vals = np.asarray(list(counts.values()), dtype=np.float32)
+        rows, cols = keys[:, 0], keys[:, 1]
+        n_u, n_i = len(user_map), len(item_map)
+        params = ALSParams(rank=p.rank, num_iterations=p.num_iterations,
+                           lambda_=p.lambda_,
+                           seed=0 if p.seed is None else p.seed)
+        _, item_factors = train_als(
+            pad_ratings(rows, cols, vals, n_u, n_i),
+            pad_ratings(cols, rows, vals, n_i, n_u),
+            params)
+        items = {item_map[iid]: item for iid, item in pd.items.items()}
+        return SimilarProductModel(item_factors, item_map, items)
+
+    def predict(self, model: SimilarProductModel,
+                query: Query) -> PredictedResult:
+        idxs = [model.item_map[i] for i in query.items
+                if i in model.item_map]
+        if not idxs:
+            return PredictedResult(())
+        qf = model.product_features[np.asarray(idxs, dtype=np.int64)]
+        # [Q, M] cosines summed over query items (scala :101-110)
+        scores = cosine_scores(qf, model.product_features)
+        scores = np.where(np.isfinite(scores), scores, 0.0)
+
+        mask = scores > 0  # keep positive-score items (scala :109)
+        mask[np.asarray(idxs, dtype=np.int64)] = False  # not the query items
+        if query.categories:
+            cats = set(query.categories)
+            for ix, item in model.items.items():
+                if not cats.intersection(item.categories):
+                    mask[ix] = False
+        if query.white_list:
+            white = {model.item_map[i] for i in query.white_list
+                     if i in model.item_map}
+            keep = np.zeros_like(mask)
+            if white:
+                keep[np.asarray(list(white), dtype=np.int64)] = True
+            mask &= keep
+        for i in query.black_list:
+            ix = model.item_map.get(i)
+            if ix is not None:
+                mask[ix] = False
+
+        scores = np.where(mask, scores, -np.inf)
+        k = min(query.num, int(mask.sum()))
+        if k <= 0:
+            return PredictedResult(())
+        top = np.argpartition(-scores, k - 1)[:k]
+        top = top[np.argsort(-scores[top])]
+        items = model.item_map.decode(top)
+        return PredictedResult(tuple(
+            ItemScore(item=str(i), score=float(scores[ix]))
+            for i, ix in zip(items, top)))
+
+
+def engine_factory() -> Engine:
+    """SimilarProductEngine (similarproduct Engine.scala)."""
+    return Engine(
+        EventDataSource,
+        PIdentityPreparator,
+        {"als": ALSAlgorithm, "": ALSAlgorithm},
+        LFirstServing,
+    )

@@ -25,8 +25,12 @@ def dispatch(args) -> int:
         return app_delete(args.name, args.force)
     if cmd == "data-delete":
         return app_data_delete(args.name, args.channel, args.force)
-    print("usage: pio app {new,list,show,delete,data-delete} ...",
-          file=sys.stderr)
+    if cmd == "channel-new":
+        return app_channel_new(args.name, args.channel)
+    if cmd == "channel-delete":
+        return app_channel_delete(args.name, args.channel, args.force)
+    print("usage: pio app {new,list,show,delete,data-delete,channel-new,"
+          "channel-delete} ...", file=sys.stderr)
     return 2
 
 
@@ -128,6 +132,60 @@ def app_data_delete(name: str, channel=None, force: bool = False) -> int:
     levents.remove(app.id, channel_id)
     levents.init(app.id, channel_id)  # wipe + reinit (App.scala data-delete)
     print(f"[INFO] Removed event data of app: {name}")
+    return 0
+
+
+def app_channel_new(name: str, channel: str) -> int:
+    """App.scala channelNew: validate name, create channel, init its event
+    store; roll back the channel row if init fails."""
+    from predictionio_tpu.data.storage.base import Channel
+
+    app = storage.get_metadata_apps().get_by_name(name)
+    if app is None:
+        print(f"[ERROR] App {name} does not exist. Aborting.",
+              file=sys.stderr)
+        return 1
+    channels = storage.get_metadata_channels()
+    if any(c.name == channel for c in channels.get_by_appid(app.id)):
+        print(f"[ERROR] Channel {channel} already exists. Aborting.",
+              file=sys.stderr)
+        return 1
+    if not Channel.is_valid_name(channel):
+        print(f"[ERROR] Channel name {channel} is invalid (1-16 "
+              "alphanumeric/dash characters). Aborting.", file=sys.stderr)
+        return 1
+    channel_id = channels.insert(Channel(id=0, name=channel, appid=app.id))
+    if channel_id is None:
+        print("[ERROR] Unable to create channel.", file=sys.stderr)
+        return 1
+    if not storage.get_levents().init(app.id, channel_id):
+        channels.delete(channel_id)
+        print("[ERROR] Unable to initialize the channel's event store.",
+              file=sys.stderr)
+        return 1
+    print(f"[INFO] Channel {channel} created for app {name}.")
+    return 0
+
+
+def app_channel_delete(name: str, channel: str, force: bool = False) -> int:
+    app = storage.get_metadata_apps().get_by_name(name)
+    if app is None:
+        print(f"[ERROR] App {name} does not exist. Aborting.",
+              file=sys.stderr)
+        return 1
+    match = next((c for c in storage.get_metadata_channels()
+                  .get_by_appid(app.id) if c.name == channel), None)
+    if match is None:
+        print(f"[ERROR] Channel {channel} does not exist. Aborting.",
+              file=sys.stderr)
+        return 1
+    if not force and not _confirm(
+            f"Delete channel {channel} of app {name} and ALL its data?"):
+        print("[INFO] Aborted.")
+        return 0
+    storage.get_levents().remove(app.id, match.id)
+    storage.get_metadata_channels().delete(match.id)
+    print(f"[INFO] Channel {channel} deleted.")
     return 0
 
 

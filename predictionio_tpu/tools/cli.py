@@ -1,7 +1,9 @@
 """``pio`` console — operator CLI.
 
-Parity target: ``tools/.../console/Console.scala:133-769`` (~30 verbs).
-This module grows verb-by-verb; currently: status, version, app.
+Parity target: ``tools/.../console/Console.scala:133-769``. Verbs:
+version, status, build, train, eval, deploy, undeploy, eventserver,
+adminserver, dashboard, app (incl. channels), accesskey, template,
+export, import.
 """
 
 from __future__ import annotations
@@ -47,7 +49,30 @@ def cmd_app(args) -> int:
     return app_commands.dispatch(args)
 
 
+def cmd_accesskey(args) -> int:
+    from predictionio_tpu.tools import accesskey_commands
+
+    return accesskey_commands.dispatch(args)
+
+
+def cmd_template(args) -> int:
+    from predictionio_tpu.tools import template_commands
+
+    return template_commands.dispatch(args)
+
+
+def _add_engine_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--engine-variant", default="engine.json",
+                   help="path to the engine variant JSON")
+    p.add_argument("--engine-factory", default=None,
+                   help="module:callable (overrides engine.json)")
+    p.add_argument("--engine-id", default=None)
+    p.add_argument("--engine-version", default=None)
+
+
 def build_parser() -> argparse.ArgumentParser:
+    from predictionio_tpu.tools import run_commands
+
     parser = argparse.ArgumentParser(
         prog="pio",
         description="predictionio-tpu console (reference: pio CLI)")
@@ -74,7 +99,93 @@ def build_parser() -> argparse.ArgumentParser:
     dd.add_argument("name")
     dd.add_argument("--channel", default=None)
     dd.add_argument("-f", "--force", action="store_true")
+    cn = app_sub.add_parser("channel-new", help="create a channel")
+    cn.add_argument("name")
+    cn.add_argument("channel")
+    cd = app_sub.add_parser("channel-delete", help="delete a channel")
+    cd.add_argument("name")
+    cd.add_argument("channel")
+    cd.add_argument("-f", "--force", action="store_true")
     app.set_defaults(func=cmd_app)
+
+    ak = sub.add_parser("accesskey", help="manage access keys")
+    ak_sub = ak.add_subparsers(dest="accesskey_command")
+    akn = ak_sub.add_parser("new", help="create an access key")
+    akn.add_argument("app_name")
+    akn.add_argument("key", nargs="?", default=None)
+    akn.add_argument("--events", nargs="*", default=None,
+                     help="allowed event names (default: all)")
+    akl = ak_sub.add_parser("list", help="list access keys")
+    akl.add_argument("app_name", nargs="?", default=None)
+    akd = ak_sub.add_parser("delete", help="delete an access key")
+    akd.add_argument("key")
+    ak.set_defaults(func=cmd_accesskey)
+
+    build = sub.add_parser("build", help="verify the engine directory")
+    _add_engine_args(build)
+    build.set_defaults(func=run_commands.cmd_build)
+
+    train = sub.add_parser("train", help="train an engine instance")
+    _add_engine_args(train)
+    train.add_argument("--batch", default="")
+    train.add_argument("--skip-sanity-check", action="store_true")
+    train.add_argument("--stop-after-read", action="store_true")
+    train.add_argument("--stop-after-prepare", action="store_true")
+    train.set_defaults(func=run_commands.cmd_train)
+
+    ev = sub.add_parser("eval", help="run an evaluation / tuning sweep")
+    ev.add_argument("evaluation", help="module:callable -> Evaluation")
+    ev.add_argument("engine_params_generator", nargs="?", default=None,
+                    help="module:callable -> EngineParamsGenerator")
+    ev.add_argument("--batch", default="")
+    ev.set_defaults(func=run_commands.cmd_eval)
+
+    dep = sub.add_parser("deploy", help="serve a trained engine instance")
+    _add_engine_args(dep)
+    dep.add_argument("--engine-instance-id", default=None)
+    dep.add_argument("--ip", default="0.0.0.0")
+    dep.add_argument("--port", type=int, default=8000)
+    dep.add_argument("--feedback", action="store_true")
+    dep.add_argument("--event-server-ip", default="0.0.0.0")
+    dep.add_argument("--event-server-port", type=int, default=7070)
+    dep.add_argument("--accesskey", default=None)
+    dep.set_defaults(func=run_commands.cmd_deploy)
+
+    undep = sub.add_parser("undeploy", help="stop a deployed engine server")
+    undep.add_argument("--ip", default="0.0.0.0")
+    undep.add_argument("--port", type=int, default=8000)
+    undep.set_defaults(func=run_commands.cmd_undeploy)
+
+    es = sub.add_parser("eventserver", help="start the event server")
+    es.add_argument("--ip", default="0.0.0.0")
+    es.add_argument("--port", type=int, default=7070)
+    es.add_argument("--stats", action="store_true")
+    es.set_defaults(func=run_commands.cmd_eventserver)
+
+    tpl = sub.add_parser("template", help="engine template scaffolds")
+    tpl_sub = tpl.add_subparsers(dest="template_command")
+    tpl_sub.add_parser("list", help="list built-in templates")
+    tg = tpl_sub.add_parser("get", help="scaffold an engine directory")
+    tg.add_argument("name")
+    tg.add_argument("directory")
+    tpl.set_defaults(func=cmd_template)
+
+    from predictionio_tpu.tools import export_import
+
+    exp = sub.add_parser("export", help="export events to a JSON-lines file")
+    exp.add_argument("--output", required=True)
+    exp.add_argument("--app-name", default=None)
+    exp.add_argument("--appid", type=int, default=None)
+    exp.add_argument("--channel", default=None)
+    exp.set_defaults(func=export_import.dispatch_export)
+
+    imp = sub.add_parser("import", help="import events from a JSON-lines file")
+    imp.add_argument("--input", required=True)
+    imp.add_argument("--app-name", default=None)
+    imp.add_argument("--appid", type=int, default=None)
+    imp.add_argument("--channel", default=None)
+    imp.set_defaults(func=export_import.dispatch_import)
+
     return parser
 
 

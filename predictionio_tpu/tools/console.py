@@ -1,0 +1,11 @@
+"""``python -m predictionio_tpu.tools.console`` — the ``pio`` console.
+
+Alias module matching the reference's entry-point name
+(``tools/.../console/Console.scala``); the implementation lives in
+:mod:`predictionio_tpu.tools.cli`.
+"""
+
+from predictionio_tpu.tools.cli import build_parser, main  # noqa: F401
+
+if __name__ == "__main__":
+    raise SystemExit(main())

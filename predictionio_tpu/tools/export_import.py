@@ -1,0 +1,114 @@
+"""Event export/import: event store ↔ JSON-lines files.
+
+Parity: ``tools/.../export/EventsToFile.scala:40-104`` (events of one
+app/channel → file of JSON events) and ``tools/.../imprt/FileToEvents.scala
+:41-103`` (file → event store). The Spark job becomes a host-side stream;
+the wire format is the same per-line event JSON the REST API uses.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    validate_event,
+)
+
+BATCH = 1000
+
+
+def _resolve(app_name: Optional[str], app_id: Optional[int],
+             channel: Optional[str]):
+    apps = storage.get_metadata_apps()
+    if app_name is not None:
+        app = apps.get_by_name(app_name)
+        if app is None:
+            raise ValueError(f"App {app_name} does not exist.")
+    elif app_id is not None:
+        app = apps.get(app_id)
+        if app is None:
+            raise ValueError(f"App ID {app_id} does not exist.")
+    else:
+        raise ValueError("one of --app-name/--appid is required")
+    channel_id = None
+    if channel is not None:
+        match = next(
+            (c for c in storage.get_metadata_channels().get_by_appid(app.id)
+             if c.name == channel), None)
+        if match is None:
+            raise ValueError(f"Channel {channel} does not exist.")
+        channel_id = match.id
+    return app.id, channel_id
+
+
+def export_events(output: str, app_name: Optional[str] = None,
+                  app_id: Optional[int] = None,
+                  channel: Optional[str] = None) -> int:
+    """Dump every event of one app/channel as JSON lines
+    (EventsToFile.scala:75-88)."""
+    aid, channel_id = _resolve(app_name, app_id, channel)
+    n = 0
+    with open(output, "w", encoding="utf-8") as f:
+        for e in storage.get_levents().find(app_id=aid,
+                                            channel_id=channel_id):
+            f.write(e.to_json())
+            f.write("\n")
+            n += 1
+    print(f"[INFO] Events are exported to {output}. ({n} events)")
+    return 0
+
+
+def import_events(input_path: str, app_name: Optional[str] = None,
+                  app_id: Optional[int] = None,
+                  channel: Optional[str] = None) -> int:
+    """Load a JSON-lines event file into the store
+    (FileToEvents.scala:85-103)."""
+    aid, channel_id = _resolve(app_name, app_id, channel)
+    # Parse + validate the WHOLE file before touching the store, so a bad
+    # line aborts with nothing inserted (no silent partial import).
+    events = []
+    with open(input_path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = Event.from_json(line)
+                validate_event(event)
+            except EventValidationError as e:
+                print(f"[ERROR] {input_path}:{lineno}: {e} "
+                      "(nothing imported)", file=sys.stderr)
+                return 1
+            events.append(event)
+    levents = storage.get_levents()
+    levents.init(aid, channel_id)
+    n = 0
+    for i in range(0, len(events), BATCH):
+        chunk = events[i:i + BATCH]
+        levents.insert_batch(chunk, aid, channel_id)
+        n += len(chunk)
+    print(f"[INFO] Events are imported. ({n} events)")
+    return 0
+
+
+def dispatch_export(args) -> int:
+    try:
+        return export_events(args.output, app_name=args.app_name,
+                             app_id=args.appid, channel=args.channel)
+    except ValueError as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 1
+
+
+def dispatch_import(args) -> int:
+    try:
+        return import_events(args.input, app_name=args.app_name,
+                             app_id=args.appid, channel=args.channel)
+    except ValueError as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 1

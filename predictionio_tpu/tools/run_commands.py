@@ -1,0 +1,209 @@
+"""``pio`` lifecycle verbs: build, train, eval, deploy, undeploy,
+eventserver.
+
+Parity: ``tools/.../console/Console.scala`` dispatch (:698-769) with the
+spark-submit/Runner layer removed — train/eval/deploy run in this host
+process (SURVEY §7: "the runner IS the TPU host process").
+
+Engine location: a directory with an ``engine.json`` variant whose
+``engineFactory`` names a ``module:callable`` (the sbt-built jar +
+manifest of the reference collapses to an importable Python package).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import sys
+from typing import Any, Dict, Optional
+
+from predictionio_tpu.workflow.create_workflow import WorkflowConfig
+
+
+def _load_variant(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _workflow_config(args, variant: Dict[str, Any]) -> WorkflowConfig:
+    factory = getattr(args, "engine_factory", None) or variant.get(
+        "engineFactory", "")
+    if not factory:
+        raise ValueError(
+            "no engine factory: set \"engineFactory\": \"module:callable\" "
+            "in engine.json or pass --engine-factory")
+    return WorkflowConfig(
+        engine_id=getattr(args, "engine_id", None) or variant.get(
+            "id", "default"),
+        engine_version=getattr(args, "engine_version", None) or variant.get(
+            "version", "default"),
+        engine_variant=args.engine_variant,
+        engine_factory=factory,
+        batch=getattr(args, "batch", "") or "",
+        skip_sanity_check=getattr(args, "skip_sanity_check", False),
+        stop_after_read=getattr(args, "stop_after_read", False),
+        stop_after_prepare=getattr(args, "stop_after_prepare", False),
+    )
+
+
+def cmd_build(args) -> int:
+    """Sanity-check the engine dir: variant parses, factory imports, params
+    typecheck (the sbt build + RegisterEngine analog, Console.scala:812-828)."""
+    from predictionio_tpu.controller.evaluation import Evaluation
+    from predictionio_tpu.workflow import core_workflow
+
+    try:
+        variant = _load_variant(args.engine_variant)
+        config = _workflow_config(args, variant)
+        factory = core_workflow.load_engine_factory(config.engine_factory)
+        engine = factory()
+        if isinstance(engine, Evaluation):
+            engine = engine.engine
+        engine.engine_params_from_variant(variant)
+    except Exception as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 1
+    print("[INFO] Engine is ready for training.")
+    return 0
+
+
+def cmd_train(args) -> int:
+    """Console train (Console.scala:834-842) -> create_workflow."""
+    from predictionio_tpu.core.base import TrainingInterruption
+    from predictionio_tpu.workflow.create_workflow import create_workflow
+
+    try:
+        variant = _load_variant(args.engine_variant)
+        config = _workflow_config(args, variant)
+        instance_id = create_workflow(config, variant=variant)
+    except TrainingInterruption as e:
+        print(f"[INFO] Training interrupted: {e}")
+        return 0
+    except Exception as e:
+        print(f"[ERROR] Training failed: {e}", file=sys.stderr)
+        return 1
+    if instance_id is None:
+        print("[INFO] Training interrupted by a stop-after flag.")
+        return 0
+    print(f"[INFO] Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    """Console eval (Console.scala:750-757): evaluation class + optional
+    params-generator class -> run_evaluation."""
+    from predictionio_tpu.controller.evaluation import (
+        Evaluation, EngineParamsGenerator)
+    from predictionio_tpu.data.storage.base import EvaluationInstance
+    from predictionio_tpu.workflow import core_workflow, run_evaluation
+    from predictionio_tpu.workflow.create_workflow import pio_env_vars
+
+    try:
+        evaluation = core_workflow.load_engine_factory(args.evaluation)()
+        if not isinstance(evaluation, Evaluation):
+            raise TypeError(f"{args.evaluation} is not an Evaluation")
+        if args.engine_params_generator:
+            generator = core_workflow.load_engine_factory(
+                args.engine_params_generator)()
+            if not isinstance(generator, EngineParamsGenerator):
+                raise TypeError(f"{args.engine_params_generator} is not an "
+                                "EngineParamsGenerator")
+            params_list = generator.engine_params_list
+        elif isinstance(evaluation, EngineParamsGenerator):
+            params_list = evaluation.engine_params_list
+        else:
+            raise ValueError(
+                "no engine params: pass an EngineParamsGenerator class or "
+                "make the Evaluation also an EngineParamsGenerator")
+    except Exception as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 1
+
+    now = _dt.datetime.now(tz=_dt.timezone.utc)
+    instance = EvaluationInstance(
+        id="", status="INIT", start_time=now, end_time=now,
+        evaluation_class=args.evaluation,
+        engine_params_generator_class=args.engine_params_generator or "",
+        batch=getattr(args, "batch", "") or "",
+        env=pio_env_vars(),
+    )
+    try:
+        result = run_evaluation(
+            evaluation.engine, params_list, instance, evaluation.evaluator,
+            evaluation=evaluation)
+    except Exception as e:
+        print(f"[ERROR] Evaluation failed: {e}", file=sys.stderr)
+        return 1
+    print(f"[INFO] {result.to_one_liner()}")
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    """Console deploy (Console.scala:844-878): serve the given or latest
+    COMPLETED engine instance until interrupted."""
+    from predictionio_tpu.workflow import QueryServer, ServerConfig
+
+    if args.feedback and not args.accesskey:
+        # CreateServer.scala:452-455: feedback requires an access key
+        print("[ERROR] Feedback loop cannot be enabled because accessKey "
+              "is empty. Pass --accesskey.", file=sys.stderr)
+        return 1
+    variant_id, variant_version = "default", "default"
+    if os.path.exists(args.engine_variant):
+        variant = _load_variant(args.engine_variant)
+        variant_id = variant.get("id", "default")
+        variant_version = variant.get("version", "default")
+    config = ServerConfig(
+        engine_instance_id=args.engine_instance_id,
+        engine_id=getattr(args, "engine_id", None) or variant_id,
+        engine_version=(getattr(args, "engine_version", None)
+                        or variant_version),
+        engine_variant=args.engine_variant,
+        ip=args.ip,
+        port=args.port,
+        feedback=args.feedback,
+        event_server_ip=args.event_server_ip,
+        event_server_port=args.event_server_port,
+        access_key=args.accesskey,
+    )
+    try:
+        server = QueryServer(config).start()
+    except Exception as e:
+        print(f"[ERROR] Deploy failed: {e}", file=sys.stderr)
+        return 1
+    host, port = server.address
+    print(f"[INFO] Engine is deployed and running. Engine API is live at "
+          f"http://{host}:{port}.")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    """Console undeploy (Console.scala:880-890): stop a running server."""
+    from predictionio_tpu.workflow import undeploy
+
+    if undeploy(args.ip, args.port):
+        print("[INFO] Undeployed.")
+        return 0
+    print(f"[ERROR] Nothing at {args.ip}:{args.port} responded to /stop.",
+          file=sys.stderr)
+    return 1
+
+
+def cmd_eventserver(args) -> int:
+    """Console eventserver (Console.scala:741-745)."""
+    from predictionio_tpu.data.api import EventServer, EventServerConfig
+
+    server = EventServer(EventServerConfig(
+        ip=args.ip, port=args.port, stats=args.stats)).start()
+    host, port = server.address
+    print(f"[INFO] Event Server is ready at http://{host}:{port}.")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0

@@ -1,0 +1,128 @@
+"""``pio template`` subcommands: list/get.
+
+Parity: ``tools/.../console/Template.scala:226-415`` — the reference
+downloads engine templates from GitHub and personalizes the package name.
+This environment has no egress, and templates here are importable packages
+rather than sbt projects, so ``get`` scaffolds an engine directory wired
+to a built-in template's factory instead of cloning.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict
+
+BUILTIN_TEMPLATES: Dict[str, Dict] = {
+    "recommendation": {
+        "description": "Implicit-ALS top-N recommendation "
+                       "(scala-parallel-recommendation parity)",
+        "engineFactory":
+            "predictionio_tpu.templates.recommendation:engine_factory",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.recommendation:engine_factory",
+            "datasource": {"params": {"appName": "INVALID_APP_NAME"}},
+            "algorithms": [{
+                "name": "als",
+                "params": {"rank": 10, "numIterations": 10,
+                           "lambda": 0.01, "seed": 3},
+            }],
+        },
+    },
+    "classification": {
+        "description": "Naive Bayes classification from $set properties "
+                       "(scala-parallel-classification parity)",
+        "engineFactory":
+            "predictionio_tpu.templates.classification:engine_factory",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.classification:engine_factory",
+            "datasource": {"params": {"appName": "INVALID_APP_NAME"}},
+            "algorithms": [{"name": "naive", "params": {"lambda": 1.0}}],
+        },
+    },
+    "similarproduct": {
+        "description": "Item-to-item similarity on view events "
+                       "(scala-parallel-similarproduct parity)",
+        "engineFactory":
+            "predictionio_tpu.templates.similarproduct:engine_factory",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.similarproduct:engine_factory",
+            "datasource": {"params": {"appName": "INVALID_APP_NAME"}},
+            "algorithms": [{
+                "name": "als",
+                "params": {"rank": 10, "numIterations": 20, "seed": 3},
+            }],
+        },
+    },
+    "ecommercerecommendation": {
+        "description": "ALS + business-rule filters at predict time "
+                       "(scala-parallel-ecommercerecommendation parity)",
+        "engineFactory":
+            "predictionio_tpu.templates.ecommercerecommendation"
+            ":engine_factory",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.ecommercerecommendation"
+                ":engine_factory",
+            "datasource": {"params": {"appName": "INVALID_APP_NAME"}},
+            "algorithms": [{
+                "name": "als",
+                "params": {"rank": 10, "numIterations": 20, "seed": 3},
+            }],
+        },
+    },
+}
+
+
+def dispatch(args) -> int:
+    cmd = getattr(args, "template_command", None)
+    if cmd == "list":
+        return template_list()
+    if cmd == "get":
+        return template_get(args.name, args.directory)
+    print("usage: pio template {list,get} ...", file=sys.stderr)
+    return 2
+
+
+def template_list() -> int:
+    print(f"[INFO] {'Template':<26} | Description")
+    for name, t in BUILTIN_TEMPLATES.items():
+        print(f"[INFO] {name:<26} | {t['description']}")
+    return 0
+
+
+def template_get(name: str, directory: str) -> int:
+    t = BUILTIN_TEMPLATES.get(name)
+    if t is None:
+        print(f"[ERROR] Template {name} not found. Try 'pio template list'.",
+              file=sys.stderr)
+        return 1
+    os.makedirs(directory, exist_ok=True)
+    variant_path = os.path.join(directory, "engine.json")
+    if os.path.exists(variant_path):
+        print(f"[ERROR] {variant_path} already exists. Aborting.",
+              file=sys.stderr)
+        return 1
+    with open(variant_path, "w", encoding="utf-8") as f:
+        json.dump(t["variant"], f, indent=2)
+        f.write("\n")
+    with open(os.path.join(directory, "template.json"), "w",
+              encoding="utf-8") as f:
+        json.dump({"pio": {"version": {"min": "0.2.0"}}}, f)
+        f.write("\n")
+    print(f"[INFO] Engine template {name} is now ready at {directory}.")
+    print("[INFO] Edit engine.json (set appName), then: "
+          "pio build && pio train && pio deploy")
+    return 0

@@ -12,14 +12,24 @@ from predictionio_tpu.workflow.core_workflow import (
     serialize_models,
     deserialize_models,
 )
+from predictionio_tpu.workflow.create_server import (
+    QueryServer,
+    ServerConfig,
+    create_server,
+    undeploy,
+)
 from predictionio_tpu.workflow.create_workflow import (
     WorkflowConfig,
     create_workflow,
 )
 
 __all__ = [
+    "QueryServer",
+    "ServerConfig",
     "WorkflowConfig",
+    "create_server",
     "create_workflow",
+    "undeploy",
     "deserialize_models",
     "load_engine_factory",
     "run_evaluation",

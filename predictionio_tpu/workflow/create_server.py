@@ -1,0 +1,545 @@
+"""Query server — the deployment daemon.
+
+Parity target: ``core/.../workflow/CreateServer.scala``:
+
+- deploy loads an EngineInstance (given ID or latest COMPLETED), rebuilds
+  EngineParams from its params snapshot (``Engine.scala:419-489``),
+  deserializes the persisted models and runs ``prepare_deploy``
+  (``CreateServer.scala:213-272``)
+- ``POST /queries.json`` = supplement → predict-per-algorithm → serve with
+  the ORIGINAL query (``:510-661``), with per-query latency bookkeeping
+- feedback loop POSTs a ``predict`` event (entityType ``pio_pr``) to the
+  event server with the query/prediction payload (``:554-616``)
+- ``POST /reload`` hot-swaps to the latest completed instance without
+  dropping the listener (``MasterActor``, ``:352-378``)
+- ``POST /stop`` undeploys; ``start()`` first undeploys any stale server
+  on the same address, and retries bind 3× (``:295-330, 383-393``)
+
+TPU adaptations: models are AOT-warmed at deploy so the first query never
+pays an XLA compile (SURVEY hard part #4 — ``warmup_query`` in the server
+config or a ``warmup_base`` hook on the algorithm); the akka actor tree is
+replaced by a threaded HTTP server plus a lock-guarded engine swap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import secrets
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller.engine import (
+    Engine,
+    EngineParams,
+    params_from_dict,
+)
+from predictionio_tpu.core.base import WorkflowParams
+from predictionio_tpu.core.context import ComputeContext, workflow_context
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.storage.base import EngineInstance, StorageError
+from predictionio_tpu.workflow import core_workflow
+from predictionio_tpu.workflow.server_plugins import EngineServerPluginContext
+
+logger = logging.getLogger("pio.queryserver")
+
+UTC = _dt.timezone.utc
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    """ServerConfig (CreateServer.scala:86-104)."""
+
+    engine_instance_id: Optional[str] = None
+    engine_id: str = "default"
+    engine_version: str = "default"
+    engine_variant: str = "engine.json"
+    ip: str = "0.0.0.0"
+    port: int = 8000
+    feedback: bool = False
+    event_server_ip: str = "0.0.0.0"
+    event_server_port: int = 7070
+    access_key: Optional[str] = None
+    batch: str = ""
+    warmup_query: Optional[Mapping[str, Any]] = None
+
+
+def engine_instance_to_engine_params(
+        engine: Engine, instance: EngineInstance) -> EngineParams:
+    """Rebuild EngineParams from the instance's JSON params snapshot
+    (Engine.scala:419-489: engineInstanceToEngineParams)."""
+    def one(snapshot: str, class_map, stage: str):
+        block = json.loads(snapshot)
+        name = block.get("name", "")
+        if name not in class_map:
+            raise ValueError(
+                f"{stage}: controller named {name!r} from the engine "
+                f"instance is not registered; known: {sorted(class_map)}")
+        cls = class_map[name]
+        return name, params_from_dict(
+            getattr(cls, "params_class", None), block.get("params", {}),
+            where=f"{stage}[{name!r}]")
+
+    algo_blocks = json.loads(instance.algorithms_params)
+    algos = []
+    for i, block in enumerate(algo_blocks):
+        algos.append(one(json.dumps(block), engine.algorithm_class_map,
+                         f"algorithms[{i}]"))
+    return EngineParams(
+        data_source_params=one(instance.data_source_params,
+                               engine.data_source_class_map, "datasource"),
+        preparator_params=one(instance.preparator_params,
+                              engine.preparator_class_map, "preparator"),
+        algorithm_params_list=algos,
+        serving_params=one(instance.serving_params,
+                           engine.serving_class_map, "serving"),
+    )
+
+
+def _camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+def _snake(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def to_jsonable(obj: Any) -> Any:
+    """Prediction/query → wire JSON. Dataclass fields go out camelCased
+    (itemScores), matching the reference's case-class serialization style."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            _camel(f.name): to_jsonable(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.floating, np.integer)):
+        return obj.item()
+    if isinstance(obj, Mapping):
+        return {str(k): to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_jsonable(v) for v in obj]
+    if isinstance(obj, _dt.datetime):
+        return obj.isoformat()
+    return obj
+
+
+def query_from_json(query_dict: Mapping[str, Any],
+                    query_cls: Optional[type]) -> Any:
+    """Typed-query extraction (JsonExtractor.extract analog): camelCase
+    keys map onto the dataclass's snake_case fields; unknown/missing keys
+    are explicit errors → 400."""
+    if query_cls is None or not dataclasses.is_dataclass(query_cls):
+        return dict(query_dict)
+    data = {_snake(k): v for k, v in query_dict.items()}
+    fields = {f.name: f for f in dataclasses.fields(query_cls)}
+    for name, f in fields.items():
+        # JSON arrays -> tuple fields
+        if name in data and isinstance(data[name], list):
+            data[name] = tuple(data[name])
+    return params_from_dict(query_cls, data, where=query_cls.__name__)
+
+
+class _Deployment:
+    """One immutable deployed engine state; swapped atomically on reload."""
+
+    def __init__(self, instance: EngineInstance, engine: Engine,
+                 engine_params: EngineParams, algorithms: List[Any],
+                 models: List[Any], serving: Any):
+        self.instance = instance
+        self.engine = engine
+        self.engine_params = engine_params
+        self.algorithms = algorithms
+        self.models = models
+        self.serving = serving
+        self.start_time = _dt.datetime.now(tz=UTC)
+
+
+class QueryServer:
+    """The deployment daemon (MasterActor + ServerActor combined)."""
+
+    def __init__(self, config: ServerConfig,
+                 engine: Optional[Engine] = None,
+                 plugin_context: Optional[EngineServerPluginContext] = None,
+                 ctx: Optional[ComputeContext] = None):
+        self.config = config
+        self._engine_override = engine
+        self.plugin_context = plugin_context or EngineServerPluginContext()
+        self.ctx = ctx or workflow_context(mode="serving", batch=config.batch)
+        self._deployment: Optional[_Deployment] = None
+        self._swap_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.request_count = 0
+        self.last_serving_sec = 0.0
+        self.avg_serving_sec = 0.0
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- deploy ------------------------------------------------------------
+    def _resolve_instance(self) -> EngineInstance:
+        instances = storage.get_metadata_engine_instances()
+        if self.config.engine_instance_id:
+            instance = instances.get(self.config.engine_instance_id)
+            if instance is None:
+                raise StorageError(
+                    f"engine instance {self.config.engine_instance_id!r} "
+                    "not found")
+            return instance
+        instance = instances.get_latest_completed(
+            self.config.engine_id, self.config.engine_version,
+            self.config.engine_variant)
+        if instance is None:
+            raise StorageError(
+                "No valid engine instance found for engine "
+                f"{self.config.engine_id} {self.config.engine_version} "
+                f"{self.config.engine_variant}. Try running train first.")
+        return instance
+
+    def deploy(self) -> "QueryServer":
+        """Load + warm the engine (createServerActorWithEngine,
+        CreateServer.scala:213-272)."""
+        instance = self._resolve_instance()
+        self._deployment = self._build_deployment(instance)
+        logger.info("Engine instance %s deployed", instance.id)
+        return self
+
+    def _build_deployment(self, instance: EngineInstance) -> _Deployment:
+        if self._engine_override is not None:
+            engine = self._engine_override
+        else:
+            factory = core_workflow.load_engine_factory(
+                instance.engine_factory)
+            engine = factory()
+            from predictionio_tpu.controller.evaluation import Evaluation
+            if isinstance(engine, Evaluation):
+                engine = engine.engine
+        engine_params = engine_instance_to_engine_params(engine, instance)
+
+        blob = storage.get_model_data_models().get(instance.id)
+        if blob is None:
+            raise StorageError(
+                f"no persisted models for engine instance {instance.id}")
+        persisted = core_workflow.deserialize_models(blob.models)
+        models = engine.prepare_deploy(
+            self.ctx, engine_params, instance.id, persisted,
+            params=WorkflowParams(batch=self.config.batch))
+
+        algorithms = engine._algorithms(engine_params)
+        sv_name, sv_params = engine_params.serving_params
+        serving = engine._make(engine.serving_class_map, sv_name, sv_params,
+                               "serving")
+        dep = _Deployment(instance, engine, engine_params, algorithms,
+                          models, serving)
+        self._warm_up(dep)
+        return dep
+
+    def _warm_up(self, dep: _Deployment) -> None:
+        """AOT-compile the predict path before the first real query."""
+        for algo, model in zip(dep.algorithms, dep.models):
+            warmup = getattr(algo, "warmup_base", None)
+            if callable(warmup):
+                try:
+                    warmup(model)
+                except Exception:
+                    logger.exception("warmup_base failed (non-fatal)")
+        if self.config.warmup_query is not None:
+            try:
+                self._serve_one(dep, dict(self.config.warmup_query))
+            except Exception:
+                logger.exception("warmup query failed (non-fatal)")
+
+    # -- the query path (CreateServer.scala:510-661) -----------------------
+    def _serve_one(self, dep: _Deployment,
+                   query_dict: Mapping[str, Any]) -> Tuple[Any, Any]:
+        query = self._extract_query(dep, query_dict)
+        supplemented = dep.serving.supplement_base(query)
+        predictions = [
+            algo.predict_base(model, supplemented)
+            for algo, model in zip(dep.algorithms, dep.models)
+        ]
+        # by design: serve with the *original* query (scala :538-540)
+        prediction = dep.serving.serve_base(query, predictions)
+        return query, prediction
+
+    @staticmethod
+    def _extract_query(dep: _Deployment,
+                       query_dict: Mapping[str, Any]) -> Any:
+        return query_from_json(query_dict, dep.algorithms[0].query_class)
+
+    def handle_query(self, body: bytes) -> Tuple[int, Any]:
+        dep = self._deployment
+        assert dep is not None, "not deployed"
+        t0 = time.perf_counter()
+        query_time = _dt.datetime.now(tz=UTC)
+        try:
+            query_dict = json.loads(body.decode("utf-8"))
+            if not isinstance(query_dict, dict):
+                raise ValueError("query must be a JSON object")
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError) as e:
+            return 400, {"message": f"{e}"}
+        # extraction errors are the client's fault (400, scala :644-651);
+        # anything thrown past extraction is an engine failure (500)
+        try:
+            query = self._extract_query(dep, query_dict)
+        except (ValueError, TypeError) as e:
+            logger.error("Query %r is invalid. Reason: %s", query_dict, e)
+            return 400, {"message": str(e)}
+        try:
+            supplemented = dep.serving.supplement_base(query)
+            predictions = [
+                algo.predict_base(model, supplemented)
+                for algo, model in zip(dep.algorithms, dep.models)
+            ]
+            prediction = dep.serving.serve_base(query, predictions)
+        except Exception as e:
+            logger.exception("query failed")
+            return 500, {"message": str(e)}
+
+        result = to_jsonable(prediction)
+        if self.config.feedback:
+            result = self._feedback(dep, query_dict, query, prediction,
+                                    result, query_time)
+        for blocker in self.plugin_context.output_blockers.values():
+            result = blocker.process(dep.instance, query_dict, result,
+                                     self.plugin_context)
+        for sniffer in self.plugin_context.output_sniffers.values():
+            try:
+                sniffer.process(dep.instance, query_dict, result,
+                                self.plugin_context)
+            except Exception:
+                logger.exception("output sniffer failed")
+
+        dt = time.perf_counter() - t0
+        with self._stats_lock:
+            self.last_serving_sec = dt
+            self.avg_serving_sec = (
+                (self.avg_serving_sec * self.request_count) + dt
+            ) / (self.request_count + 1)
+            self.request_count += 1
+        return 200, result
+
+    def _feedback(self, dep: _Deployment, query_dict: Mapping[str, Any],
+                  query: Any, prediction: Any, result: Any,
+                  query_time: _dt.datetime) -> Any:
+        """Async predict-event POST to the event server
+        (CreateServer.scala:554-616)."""
+        org = getattr(prediction, "pr_id", None) or query_dict.get("prId")
+        pr_id = org or secrets.token_hex(32)
+        data = {
+            "event": "predict",
+            "eventTime": query_time.isoformat(),
+            "entityType": "pio_pr",
+            "entityId": pr_id,
+            "properties": {
+                "engineInstanceId": dep.instance.id,
+                "query": to_jsonable(query),
+                "prediction": result,
+            },
+        }
+        if "prId" in query_dict:
+            data["prId"] = query_dict["prId"]
+        url = (f"http://{self.config.event_server_ip}:"
+               f"{self.config.event_server_port}/events.json"
+               f"?accessKey={self.config.access_key or ''}")
+
+        def post():
+            try:
+                req = urllib.request.Request(
+                    url, data=json.dumps(data).encode("utf-8"),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    if resp.status != 201:
+                        logger.error(
+                            "Feedback event failed. Status code: %d. "
+                            "Data: %s.", resp.status, data)
+            except Exception as e:
+                logger.error("Feedback event failed: %s", e)
+
+        threading.Thread(target=post, daemon=True,
+                         name="pio-feedback").start()
+        # inject prId into the response when the prediction carries one
+        if hasattr(prediction, "pr_id") and isinstance(result, dict):
+            result = dict(result, prId=pr_id)
+        return result
+
+    # -- reload / status ---------------------------------------------------
+    def reload(self) -> str:
+        """Hot-swap to the latest completed instance
+        (MasterActor ReloadServer, CreateServer.scala:352-378)."""
+        with self._swap_lock:
+            instances = storage.get_metadata_engine_instances()
+            latest = instances.get_latest_completed(
+                self.config.engine_id, self.config.engine_version,
+                self.config.engine_variant)
+            if latest is None:
+                raise StorageError("No valid engine instance found for "
+                                   "reload")
+            self._deployment = self._build_deployment(latest)
+            return latest.id
+
+    def status(self) -> Dict[str, Any]:
+        dep = self._deployment
+        with self._stats_lock:
+            counts = {
+                "requestCount": self.request_count,
+                "avgServingSec": self.avg_serving_sec,
+                "lastServingSec": self.last_serving_sec,
+            }
+        return {
+            "status": "alive",
+            "engineInstanceId": dep.instance.id if dep else None,
+            "engineFactory": dep.instance.engine_factory if dep else None,
+            "startTime": dep.start_time.isoformat() if dep else None,
+            "algorithms": [type(a).__name__ for a in dep.algorithms]
+            if dep else [],
+            "feedback": self.config.feedback,
+            **counts,
+        }
+
+    # -- HTTP lifecycle ----------------------------------------------------
+    def start(self, undeploy_stale: bool = True,
+              bind_retries: int = 3) -> "QueryServer":
+        if self._deployment is None:
+            self.deploy()
+        if undeploy_stale:
+            undeploy(self.config.ip, self.config.port)
+        server = self
+
+        class Handler(_QueryHandler):
+            query_server = server
+
+        last_err: Optional[Exception] = None
+        for attempt in range(bind_retries):
+            try:
+                self._httpd = ThreadingHTTPServer(
+                    (self.config.ip, self.config.port), Handler)
+                break
+            except OSError as e:  # bind failure, retry (scala :383-393)
+                last_err = e
+                logger.warning("Bind failed (attempt %d): %s", attempt + 1, e)
+                time.sleep(1.0)
+        else:
+            raise RuntimeError(
+                f"Bind failed after {bind_retries} tries") from last_err
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pio-queryserver",
+            daemon=True)
+        self._thread.start()
+        logger.info("Query server started on %s:%d", *self.address)
+        return self
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        assert self._httpd is not None, "server not started"
+        host, port = self._httpd.server_address[:2]
+        return str(host), int(port)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            httpd, self._httpd = self._httpd, None
+            httpd.shutdown()  # stops serve_forever, THEN close the socket
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def serve_forever(self) -> None:
+        if self._httpd is None:
+            self.start()
+        assert self._thread is not None
+        self._thread.join()
+
+
+def undeploy(ip: str, port: int) -> bool:
+    """POST /stop to a stale server before binding
+    (CreateServer.scala:295-330). True if something answered."""
+    host = "127.0.0.1" if ip == "0.0.0.0" else ip
+    try:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/stop", data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=3) as resp:
+            logger.info("Undeployed stale server at %s:%d (%d)",
+                        host, port, resp.status)
+            return True
+    except (urllib.error.URLError, OSError):
+        return False
+
+
+class _QueryHandler(BaseHTTPRequestHandler):
+    query_server: QueryServer
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+    def _respond(self, status: int, payload: Any) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _drain(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def do_GET(self):
+        srv = self.query_server
+        path = urllib.parse.urlsplit(self.path).path.rstrip("/") or "/"
+        self._drain()
+        if path == "/":
+            self._respond(200, srv.status())
+        elif path == "/plugins.json":
+            self._respond(200, srv.plugin_context.describe())
+        else:
+            self._respond(404, {"message": "Not Found"})
+
+    def do_POST(self):
+        srv = self.query_server
+        path = urllib.parse.urlsplit(self.path).path.rstrip("/") or "/"
+        body = self._drain()
+        try:
+            if path == "/queries.json":
+                status, payload = srv.handle_query(body)
+                self._respond(status, payload)
+            elif path == "/reload":
+                iid = srv.reload()
+                self._respond(200, {"message": "Reloading...",
+                                    "engineInstanceId": iid})
+            elif path == "/stop":
+                self._respond(200, {"message": "Shutting down."})
+                threading.Thread(target=srv.stop, daemon=True).start()
+            else:
+                self._respond(404, {"message": "Not Found"})
+        except Exception as e:
+            logger.exception("unhandled error on POST %s", path)
+            try:
+                self._respond(500, {"message": str(e)})
+            except Exception:
+                pass
+
+
+def create_server(config: ServerConfig, **kwargs) -> QueryServer:
+    """CreateServer.main analog (CreateServer.scala:119-211)."""
+    return QueryServer(config, **kwargs)

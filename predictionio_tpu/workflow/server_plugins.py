@@ -1,0 +1,66 @@
+"""Engine-server plugin SPI — output blockers and sniffers.
+
+Parity target: ``core/.../workflow/EngineServerPlugin.scala:21-40`` +
+``EngineServerPluginContext.scala:36-88``. ServiceLoader discovery is
+replaced by an explicit registry; the plugins actor by direct calls.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from typing import Any, Dict, List, Optional
+
+OUTPUT_BLOCKER = "outputblocker"
+OUTPUT_SNIFFER = "outputsniffer"
+
+
+class EngineServerPlugin(abc.ABC):
+    """Transforms (blocker) or observes (sniffer) query-server output."""
+
+    plugin_name: str = ""
+    plugin_description: str = ""
+    plugin_type: str = OUTPUT_SNIFFER
+
+    @abc.abstractmethod
+    def process(self, engine_instance, query: Any, prediction: Any,
+                context: "EngineServerPluginContext") -> Any:
+        """Blockers return the (possibly rewritten) prediction JSON;
+        sniffers' return value is ignored."""
+
+    def handle_rest(self, args: List[str]) -> str:
+        return "{}"
+
+
+class EngineServerPluginContext:
+    """Active plugins split by type (EngineServerPluginContext.scala:36-58)."""
+
+    def __init__(self, plugins: Optional[List[EngineServerPlugin]] = None,
+                 logger: Optional[logging.Logger] = None):
+        self.logger = logger or logging.getLogger("pio.queryserver.plugins")
+        self.output_blockers: Dict[str, EngineServerPlugin] = {}
+        self.output_sniffers: Dict[str, EngineServerPlugin] = {}
+        for p in plugins or []:
+            self.register(p)
+
+    def register(self, plugin: EngineServerPlugin) -> None:
+        target = (self.output_blockers
+                  if plugin.plugin_type == OUTPUT_BLOCKER
+                  else self.output_sniffers)
+        target[plugin.plugin_name] = plugin
+
+    def describe(self) -> Dict[str, Any]:
+        """Wire shape of GET /plugins.json (CreateServer.scala:714-732)."""
+        def block(ps: Dict[str, EngineServerPlugin]):
+            return {
+                n: {
+                    "name": p.plugin_name,
+                    "description": p.plugin_description,
+                    "class": type(p).__module__ + "." + type(p).__qualname__,
+                }
+                for n, p in ps.items()
+            }
+        return {"plugins": {
+            "outputblockers": block(self.output_blockers),
+            "outputsniffers": block(self.output_sniffers),
+        }}

@@ -1,0 +1,49 @@
+"""Evaluation fixture loadable by module path from the ``pio eval`` verb."""
+
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+)
+from predictionio_tpu.controller.metrics import AverageMetric
+from predictionio_tpu.ops.als import ALSParams
+from predictionio_tpu.templates.recommendation import (
+    DataSourceParams,
+    engine_factory,
+)
+
+
+class PrecisionAt10(AverageMetric):
+    def calculate_qpa(self, q, p, a):
+        predicted = {s.item for s in p.item_scores}
+        if not predicted:
+            return 0.0
+        return len(predicted & set(a.items)) / len(predicted)
+
+
+class RecEvaluation(Evaluation, EngineParamsGenerator):
+    def __init__(self):
+        Evaluation.__init__(self)
+        EngineParamsGenerator.__init__(self)
+        # engine_metrics (not engine_metric) -> no best.json side file
+        self.engine_metrics = (engine_factory(), PrecisionAt10(), ())
+
+
+class RecGenerator(EngineParamsGenerator):
+    def __init__(self):
+        super().__init__()
+        base = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="evalapp")))
+        self.engine_params_list = [
+            base.replace(algorithm_params_list=[
+                ("als", ALSParams(rank=r, num_iterations=2, seed=0))])
+            for r in (2, 4)
+        ]
+
+
+def make_evaluation():
+    return RecEvaluation()
+
+
+def make_generator():
+    return RecGenerator()

@@ -48,3 +48,160 @@ class TestCli:
         assert main(["app", "delete", "myapp", "-f"]) == 0
         assert storage.get_metadata_apps().get_by_name("myapp") is None
         assert storage.get_metadata_access_keys().get_by_appid(app.id) == []
+
+    def test_channel_lifecycle(self, mem_storage, capsys):
+        main(["app", "new", "chanapp"])
+        assert main(["app", "channel-new", "chanapp", "weblogs"]) == 0
+        assert main(["app", "channel-new", "chanapp", "weblogs"]) == 1  # dup
+        assert main(["app", "channel-new", "chanapp", "bad name!"]) == 1
+        assert main(["app", "channel-new", "noapp", "c"]) == 1
+        capsys.readouterr()
+        assert main(["app", "show", "chanapp"]) == 0
+        assert "weblogs" in capsys.readouterr().out
+        assert main(["app", "channel-delete", "chanapp", "weblogs",
+                     "-f"]) == 0
+        app = storage.get_metadata_apps().get_by_name("chanapp")
+        assert storage.get_metadata_channels().get_by_appid(app.id) == []
+
+    def test_accesskey_lifecycle(self, mem_storage, capsys):
+        main(["app", "new", "akapp"])
+        capsys.readouterr()
+        assert main(["accesskey", "new", "akapp", "--events", "rate",
+                     "buy"]) == 0
+        out = capsys.readouterr().out
+        key = out.split("access key:")[-1].strip()
+        assert len(key) == 64
+        app = storage.get_metadata_apps().get_by_name("akapp")
+        keys = storage.get_metadata_access_keys().get_by_appid(app.id)
+        assert any(k.events == ("rate", "buy") for k in keys)
+
+        assert main(["accesskey", "list", "akapp"]) == 0
+        assert key in capsys.readouterr().out
+        assert main(["accesskey", "delete", key]) == 0
+        assert main(["accesskey", "delete", key]) == 1
+        assert main(["accesskey", "new", "noapp"]) == 1
+
+
+class TestExportImport:
+    def test_roundtrip(self, mem_storage, tmp_path, capsys):
+        from predictionio_tpu.data.event import Event
+
+        main(["app", "new", "expapp"])
+        app = storage.get_metadata_apps().get_by_name("expapp")
+        le = storage.get_levents()
+        for i in range(5):
+            le.insert(Event(event="rate", entity_type="user",
+                            entity_id=f"u{i}", target_entity_type="item",
+                            target_entity_id="i1",
+                            properties={"rating": float(i)}), app.id)
+        out = str(tmp_path / "events.jsonl")
+        assert main(["export", "--app-name", "expapp", "--output", out]) == 0
+        assert len(open(out).read().strip().splitlines()) == 5
+
+        main(["app", "new", "impapp"])
+        assert main(["import", "--app-name", "impapp", "--input", out]) == 0
+        app2 = storage.get_metadata_apps().get_by_name("impapp")
+        events = list(le.find(app2.id))
+        assert len(events) == 5
+        assert {e.entity_id for e in events} == {f"u{i}" for i in range(5)}
+
+    def test_bad_args(self, mem_storage, tmp_path, capsys):
+        assert main(["export", "--app-name", "ghost", "--output",
+                     str(tmp_path / "x")]) == 1
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text('{"event": "", "entityType": "u", "entityId": "1"}\n')
+        main(["app", "new", "impbad"])
+        assert main(["import", "--app-name", "impbad", "--input",
+                     str(bad)]) == 1
+
+
+class TestTemplateAndLifecycleVerbs:
+    def seed(self, app_name="cliapp", n_users=12):
+        import datetime as dt
+        import numpy as np
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.base import App
+
+        aid = storage.get_metadata_apps().insert(App(0, app_name))
+        le = storage.get_levents()
+        le.init(aid)
+        rng = np.random.default_rng(1)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        le.insert_batch([
+            Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{rng.integers(0, 6)}",
+                  properties={"rating": float(rng.integers(1, 6))},
+                  event_time=t0)
+            for u in range(n_users) for _ in range(5)], aid)
+        return aid
+
+    def test_template_list_get_build_train(self, mem_storage, tmp_path,
+                                           capsys, monkeypatch):
+        import json
+
+        assert main(["template", "list"]) == 0
+        assert "recommendation" in capsys.readouterr().out
+
+        engine_dir = tmp_path / "myengine"
+        assert main(["template", "get", "recommendation",
+                     str(engine_dir)]) == 0
+        variant_path = engine_dir / "engine.json"
+        assert main(["template", "get", "recommendation",
+                     str(engine_dir)]) == 1  # already exists
+        assert main(["template", "get", "nope", str(tmp_path / "x")]) == 1
+        capsys.readouterr()
+
+        self.seed()
+        variant = json.loads(variant_path.read_text())
+        variant["datasource"]["params"]["appName"] = "cliapp"
+        variant["algorithms"][0]["params"].update(
+            {"rank": 4, "numIterations": 2})
+        variant_path.write_text(json.dumps(variant))
+
+        assert main(["build", "--engine-variant", str(variant_path)]) == 0
+        assert "ready for training" in capsys.readouterr().out
+
+        assert main(["train", "--engine-variant", str(variant_path)]) == 0
+        out = capsys.readouterr().out
+        assert "Training completed" in out
+        iid = out.split("ID:")[-1].strip()
+        instance = storage.get_metadata_engine_instances().get(iid)
+        assert instance.status == "COMPLETED"
+        assert storage.get_model_data_models().get(iid) is not None
+
+    def test_train_stop_after_read(self, mem_storage, tmp_path, capsys):
+        import json
+
+        engine_dir = tmp_path / "e2"
+        main(["template", "get", "recommendation", str(engine_dir)])
+        self.seed("stopapp")
+        variant_path = engine_dir / "engine.json"
+        variant = json.loads(variant_path.read_text())
+        variant["datasource"]["params"]["appName"] = "stopapp"
+        variant_path.write_text(json.dumps(variant))
+        capsys.readouterr()
+        assert main(["train", "--engine-variant", str(variant_path),
+                     "--stop-after-read"]) == 0
+        assert "interrupted" in capsys.readouterr().out
+
+    def test_build_errors(self, mem_storage, tmp_path, capsys):
+        import json
+
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"engineFactory": "nope.nope:f"}))
+        assert main(["build", "--engine-variant", str(bad)]) == 1
+        none = tmp_path / "none.json"
+        none.write_text(json.dumps({}))
+        assert main(["build", "--engine-variant", str(none)]) == 1
+
+    def test_eval_verb(self, mem_storage, capsys):
+        self.seed("evalapp", n_users=10)
+        assert main(["eval", "tests.cli_eval_fixture:make_evaluation",
+                     "tests.cli_eval_fixture:make_generator"]) == 0
+        out = capsys.readouterr().out
+        assert "[INFO]" in out
+        rows = storage.get_metadata_evaluation_instances().get_completed()
+        assert len(rows) == 1
+        assert rows[0].evaluation_class == (
+            "tests.cli_eval_fixture:make_evaluation")

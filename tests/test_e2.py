@@ -1,0 +1,132 @@
+"""e2 library tests (mirrors e2/src/test fixtures: NaiveBayesFixture,
+MarkovChainFixture, BinaryVectorizerFixture, CrossValidationTest)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    LabeledPoint,
+    MarkovChain,
+    split_data,
+)
+
+# The reference's NaiveBayesFixture: wether/play tennis-style points
+POINTS = [
+    LabeledPoint("play", ("sunny", "hot", "weak")),
+    LabeledPoint("play", ("overcast", "mild", "strong")),
+    LabeledPoint("play", ("rain", "mild", "weak")),
+    LabeledPoint("stay", ("rain", "cool", "strong")),
+    LabeledPoint("stay", ("sunny", "hot", "strong")),
+]
+
+
+class TestCategoricalNaiveBayes:
+    def test_priors_and_likelihoods(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.priors["play"] == pytest.approx(math.log(3 / 5))
+        assert model.priors["stay"] == pytest.approx(math.log(2 / 5))
+        # P(sunny | play) = 1/3
+        assert model.likelihoods["play"][0]["sunny"] == pytest.approx(
+            math.log(1 / 3))
+        # P(strong | stay) = 2/2
+        assert model.likelihoods["stay"][2]["strong"] == pytest.approx(0.0)
+        assert model.feature_count == 3
+
+    def test_log_score(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        s = model.log_score(LabeledPoint("play", ("rain", "mild", "weak")))
+        expected = (math.log(3 / 5) + math.log(1 / 3) + math.log(2 / 3)
+                    + math.log(2 / 3))
+        assert s == pytest.approx(expected)
+        # unknown label -> None (scala :110-113)
+        assert model.log_score(
+            LabeledPoint("nope", ("rain", "mild", "weak"))) is None
+        # unseen value -> -inf by default
+        assert model.log_score(
+            LabeledPoint("play", ("foggy", "mild", "weak"))) == -math.inf
+        # custom default likelihood (scala defaultLikelihood param)
+        s = model.log_score(LabeledPoint("play", ("foggy", "mild", "weak")),
+                            default_likelihood=lambda ls: min(ls) - 1.0)
+        assert math.isfinite(s)
+
+    def test_predict(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        assert model.predict(("rain", "mild", "weak")) == "play"
+        assert model.predict(("rain", "cool", "strong")) == "stay"
+
+    def test_predict_batch_matches_single(self):
+        model = CategoricalNaiveBayes.train(POINTS)
+        feats = [p.features for p in POINTS]
+        assert model.predict_batch(feats) == [
+            model.predict(f) for f in feats]
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            CategoricalNaiveBayes.train([])
+
+
+class TestMarkovChain:
+    def test_row_normalized(self):
+        # tallies: 0->1: 3, 0->2: 1, 1->0: 2
+        model = MarkovChain.train([0, 0, 1], [1, 2, 0], [3, 1, 2],
+                                  n_states=3, top_n=3)
+        assert model.transition[0] == pytest.approx([0.0, 0.75, 0.25])
+        assert model.transition[1] == pytest.approx([1.0, 0.0, 0.0])
+        assert model.transition[2] == pytest.approx([0.0, 0.0, 0.0])
+
+    def test_top_n_truncation_keeps_full_total(self):
+        # row 0 tallies 5,3,2 -> top-2 keeps 5 and 3, normalized by 10
+        model = MarkovChain.train([0, 0, 0], [0, 1, 2], [5, 3, 2],
+                                  n_states=3, top_n=2)
+        assert model.transition[0] == pytest.approx([0.5, 0.3, 0.0])
+
+    def test_predict_vector_product(self):
+        model = MarkovChain.train([0, 1], [1, 2], [1, 1], n_states=3,
+                                  top_n=3)
+        out = model.predict([1.0, 0.5, 0.0])
+        assert out == pytest.approx([0.0, 1.0, 0.5])
+
+
+class TestBinaryVectorizer:
+    def test_from_maps_filters_properties(self):
+        maps = [{"color": "red", "size": "L", "junk": "x"},
+                {"color": "blue", "size": "L"}]
+        bv = BinaryVectorizer.from_maps(maps, ["color", "size"])
+        assert bv.num_features == 3  # red, L, blue (junk excluded)
+        vec = bv.to_binary([("color", "red"), ("size", "L")])
+        assert vec.sum() == 2.0
+        # unknown pair ignored
+        assert bv.to_binary([("color", "green")]).sum() == 0.0
+
+    def test_batch_and_str(self):
+        bv = BinaryVectorizer.from_pairs([("a", "1"), ("b", "2")])
+        out = bv.to_binary_batch([[("a", "1")], [("b", "2"), ("a", "1")]])
+        assert out.shape == (2, 2)
+        assert out[1].tolist() == [1.0, 1.0]
+        assert "BinaryVectorizer(2)" in str(bv)
+
+
+class TestSplitData:
+    def test_folds_partition_xor(self):
+        data = list(range(10))
+        folds = split_data(3, data, "EI", list, lambda d: f"q{d}",
+                           lambda d: f"a{d}")
+        assert len(folds) == 3
+        for fold_idx, (train, ei, qa) in enumerate(folds):
+            assert ei == "EI"
+            test_points = {int(q[1:]) for q, _ in qa}
+            assert test_points == {d for i, d in enumerate(data)
+                                   if i % 3 == fold_idx}
+            assert set(train) | test_points == set(data)
+            assert not set(train) & test_points
+        # every point tests exactly once across folds
+        all_test = [q for _, _, qa in folds for q, _ in qa]
+        assert len(all_test) == 10
+
+    def test_bad_k(self):
+        with pytest.raises(ValueError):
+            split_data(0, [1], None, list, str, str)

@@ -1,0 +1,241 @@
+"""Query-server tests: deploy a trained ALS instance and answer queries
+over HTTP (CreateServer.scala behavior: query path, feedback loop, reload,
+undeploy-before-bind)."""
+
+import datetime as dt
+import json
+import time
+import urllib.parse
+
+import numpy as np
+import pytest
+
+import http.client
+
+from predictionio_tpu.controller import ComputeContext, EngineParams
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.api import EventServer, EventServerConfig
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import AccessKey, App
+from predictionio_tpu.ops.als import ALSParams
+from predictionio_tpu.templates.recommendation import (
+    DataSourceParams,
+    Query,
+    engine_factory,
+)
+from predictionio_tpu.workflow import QueryServer, ServerConfig, run_train
+from predictionio_tpu.workflow.create_server import (
+    engine_instance_to_engine_params,
+    query_from_json,
+    to_jsonable,
+)
+from predictionio_tpu.workflow.create_workflow import (
+    WorkflowConfig,
+    new_engine_instance,
+)
+
+UTC = dt.timezone.utc
+CTX = ComputeContext()
+FACTORY = "predictionio_tpu.templates.recommendation:engine_factory"
+
+
+def seed_ratings(app_name="recapp"):
+    aid = storage.get_metadata_apps().insert(App(0, app_name))
+    le = storage.get_levents()
+    le.init(aid)
+    rng = np.random.default_rng(0)
+    t0 = dt.datetime(2021, 1, 1, tzinfo=UTC)
+    events = []
+    for u in range(20):
+        group = "a" if u < 10 else "b"
+        for _ in range(8):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"{group}{rng.integers(0, 10)}",
+                properties={"rating": float(rng.integers(4, 6))},
+                event_time=t0))
+    le.insert_batch(events, aid)
+    return aid
+
+
+def train_once(batch=""):
+    engine = engine_factory()
+    params = EngineParams(
+        data_source_params=("", DataSourceParams(app_name="recapp")),
+        algorithm_params_list=[
+            ("als", ALSParams(rank=8, num_iterations=3, seed=0))],
+    )
+    config = WorkflowConfig(engine_factory=FACTORY, batch=batch)
+    instance = new_engine_instance(config, params)
+    iid = run_train(engine, params, instance, ctx=CTX)
+    assert iid is not None
+    return iid
+
+
+@pytest.fixture
+def trained(mem_storage):
+    seed_ratings()
+    return train_once()
+
+
+def post(addr, path, body, params=None):
+    host, port = addr
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    target = path + ("?" + urllib.parse.urlencode(params) if params else "")
+    conn.request("POST", target, body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = json.loads(resp.read().decode("utf-8"))
+    conn.close()
+    return resp.status, data
+
+
+def get(addr, path):
+    host, port = addr
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = json.loads(resp.read().decode("utf-8"))
+    conn.close()
+    return resp.status, data
+
+
+@pytest.fixture
+def server(trained):
+    srv = QueryServer(ServerConfig(ip="127.0.0.1", port=0)).start(
+        undeploy_stale=False)
+    yield srv
+    srv.stop()
+
+
+class TestQueryPath:
+    def test_queries_json(self, server):
+        status, result = post(server.address, "/queries.json",
+                              {"user": "u1", "num": 3})
+        assert status == 200
+        assert len(result["itemScores"]) == 3
+        top = result["itemScores"][0]
+        assert top["item"].startswith("a") and top["score"] > 0
+
+    def test_unknown_user_empty(self, server):
+        status, result = post(server.address, "/queries.json",
+                              {"user": "nobody"})
+        assert status == 200 and result["itemScores"] == []
+
+    def test_bad_query_400(self, server):
+        status, result = post(server.address, "/queries.json",
+                              {"bogusField": 1})
+        assert status == 400
+        status, _ = post(server.address, "/queries.json", "notadict")
+        assert status == 400
+
+    def test_status_page_bookkeeping(self, server):
+        post(server.address, "/queries.json", {"user": "u1"})
+        post(server.address, "/queries.json", {"user": "u2"})
+        status, page = get(server.address, "/")
+        assert status == 200
+        assert page["status"] == "alive"
+        assert page["requestCount"] == 2
+        assert page["avgServingSec"] > 0
+        assert page["algorithms"] == ["ALSAlgorithm"]
+
+    def test_plugins_json(self, server):
+        status, page = get(server.address, "/plugins.json")
+        assert status == 200
+        assert set(page["plugins"]) == {"outputblockers", "outputsniffers"}
+
+
+class TestReload:
+    def test_reload_hot_swaps_latest(self, server):
+        _, before = get(server.address, "/")
+        iid2 = train_once()
+        status, data = post(server.address, "/reload", {})
+        assert status == 200 and data["engineInstanceId"] == iid2
+        _, after = get(server.address, "/")
+        assert after["engineInstanceId"] == iid2 != before["engineInstanceId"]
+        # still serves
+        status, result = post(server.address, "/queries.json", {"user": "u1"})
+        assert status == 200 and result["itemScores"]
+
+
+class TestFeedbackLoop:
+    def test_predict_event_posted(self, trained, mem_storage):
+        aid = storage.get_metadata_apps().get_by_name("recapp").id
+        storage.get_metadata_access_keys().insert(
+            AccessKey(key="fbkey", appid=aid))
+        es = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                         reg=mem_storage).start()
+        qs = QueryServer(ServerConfig(
+            ip="127.0.0.1", port=0, feedback=True,
+            event_server_ip=es.address[0],
+            event_server_port=es.address[1],
+            access_key="fbkey")).start(undeploy_stale=False)
+        try:
+            status, result = post(qs.address, "/queries.json", {"user": "u1"})
+            assert status == 200
+            deadline = time.time() + 10
+            fb = []
+            while time.time() < deadline and not fb:
+                fb = list(storage.get_levents().find(
+                    app_id=aid, entity_type="pio_pr"))
+                time.sleep(0.05)
+            assert fb, "feedback predict event never arrived"
+            ev = fb[0]
+            assert ev.event == "predict"
+            props = ev.properties
+            assert props["query"] == {"user": "u1", "items": [],
+                                      "num": 10, "blacklist": []}
+            assert props["prediction"]["itemScores"]
+            assert props["engineInstanceId"]
+        finally:
+            qs.stop()
+            es.stop()
+
+
+class TestUndeploy:
+    def test_stale_server_undeployed_before_bind(self, trained):
+        first = QueryServer(ServerConfig(ip="127.0.0.1", port=0)).start(
+            undeploy_stale=False)
+        port = first.address[1]
+        second = QueryServer(ServerConfig(ip="127.0.0.1", port=port)).start()
+        try:
+            status, result = post(second.address, "/queries.json",
+                                  {"user": "u1"})
+            assert status == 200 and result["itemScores"]
+        finally:
+            second.stop()
+            first.stop()
+
+
+class TestHelpers:
+    def test_engine_instance_to_engine_params(self, trained):
+        instance = storage.get_metadata_engine_instances().get(trained)
+        engine = engine_factory()
+        ep = engine_instance_to_engine_params(engine, instance)
+        assert ep.data_source_params[1].app_name == "recapp"
+        name, algo_params = ep.algorithm_params_list[0]
+        assert name == "als"
+        assert (algo_params.rank, algo_params.num_iterations) == (8, 3)
+
+    def test_query_from_json_camel_case(self):
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class Q:
+            user_id: str
+            num: int = 5
+            black_list: tuple = ()
+
+        q = query_from_json(
+            {"userId": "u9", "blackList": ["x"]}, Q)
+        assert q == Q(user_id="u9", num=5, black_list=("x",))
+        with pytest.raises(Exception):
+            query_from_json({"nope": 1}, Q)
+
+    def test_to_jsonable(self):
+        q = Query(user="u1", items=("a", "b"))
+        assert to_jsonable(q) == {"user": "u1", "items": ["a", "b"],
+                                  "num": 10, "blacklist": []}
+        assert to_jsonable(np.float32(1.5)) == 1.5
+        assert to_jsonable({"a": np.arange(2)}) == {"a": [0, 1]}

@@ -1,0 +1,320 @@
+"""Template tests: classification, similarproduct, ecommercerecommendation
+(end-to-end through the DASE engine on in-memory storage)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import ComputeContext, EngineParams
+from predictionio_tpu.core.base import WorkflowParams
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+
+UTC = dt.timezone.utc
+CTX = ComputeContext()
+T0 = dt.datetime(2021, 6, 1, tzinfo=UTC)
+
+
+def make_app(name):
+    aid = storage.get_metadata_apps().insert(App(0, name))
+    storage.get_levents().init(aid)
+    return aid
+
+
+def ev(event, etype, eid, tet=None, tei=None, props=None, t=T0):
+    return Event(event=event, entity_type=etype, entity_id=eid,
+                 target_entity_type=tet, target_entity_id=tei,
+                 properties=props or {}, event_time=t)
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+class TestClassificationTemplate:
+    @pytest.fixture
+    def app(self, mem_storage):
+        aid = make_app("clsapp")
+        le = storage.get_levents()
+        rng = np.random.default_rng(0)
+        events = []
+        # two separable classes: plan 0 has high attr0, plan 1 high attr2
+        for i in range(30):
+            label = i % 2
+            base = [1.0, 3.0, 1.0]
+            base[0 if label == 0 else 2] += 10.0 + rng.random()
+            events.append(ev("$set", "user", f"u{i}", props={
+                "plan": float(label),
+                "attr0": base[0], "attr1": base[1], "attr2": base[2]}))
+        # one user missing the label -> must be excluded by `required`
+        events.append(ev("$set", "user", "unlabeled", props={
+            "attr0": 1.0, "attr1": 1.0, "attr2": 1.0}))
+        le.insert_batch(events, aid)
+        return aid
+
+    def make_params(self, algos):
+        from predictionio_tpu.templates.classification import DataSourceParams
+        return EngineParams(
+            data_source_params=("", DataSourceParams(app_name="clsapp")),
+            algorithm_params_list=algos,
+        )
+
+    def test_train_and_predict(self, app):
+        from predictionio_tpu.templates.classification import (
+            NaiveBayesParams, Query, engine_factory)
+
+        engine = engine_factory()
+        params = self.make_params([("naive", NaiveBayesParams(lambda_=1.0))])
+        ds = engine._make(engine.data_source_class_map, "",
+                          params.data_source_params[1], "ds")
+        td = ds.read_training_base(CTX)
+        assert len(td.labeled_points) == 30  # unlabeled user excluded
+
+        models = engine.train(CTX, params)
+        model = models[0]
+        algo = engine._algorithms(params)[0]
+        assert algo.predict(
+            model, Query(features=(12.0, 3.0, 1.0))).label == 0.0
+        assert algo.predict(
+            model, Query(features=(1.0, 3.0, 12.0))).label == 1.0
+
+    def test_multi_algorithm_ensemble(self, app):
+        from predictionio_tpu.templates.classification import (
+            NaiveBayesParams, engine_factory)
+
+        engine = engine_factory()
+        params = self.make_params([
+            ("naive", NaiveBayesParams()), ("categorical", None)])
+        models = engine.train(CTX, params)
+        assert len(models) == 2
+
+    def test_eval_accuracy(self, app):
+        from predictionio_tpu.templates.classification import (
+            Accuracy, NaiveBayesParams, engine_factory)
+
+        engine = engine_factory()
+        params = self.make_params([("naive", NaiveBayesParams())])
+        results = engine.eval(CTX, params, WorkflowParams())
+        assert len(results) == 3  # eval_k folds
+        metric = Accuracy()
+        score = metric.calculate(CTX, results)
+        assert score > 0.9  # separable data
+
+    def test_batch_predict_matches_single(self, app):
+        from predictionio_tpu.templates.classification import (
+            NaiveBayesParams, Query, engine_factory)
+
+        engine = engine_factory()
+        params = self.make_params([("naive", NaiveBayesParams())])
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        queries = [(i, Query(features=(float(i), 2.0, 5.0)))
+                   for i in range(5)]
+        batch = dict(algo.batch_predict(CTX, model, queries))
+        for qx, q in queries:
+            assert batch[qx] == algo.predict(model, q)
+
+
+# ---------------------------------------------------------------------------
+# similarproduct
+# ---------------------------------------------------------------------------
+
+class TestSimilarProductTemplate:
+    @pytest.fixture
+    def app(self, mem_storage):
+        aid = make_app("simapp")
+        le = storage.get_levents()
+        rng = np.random.default_rng(1)
+        events = []
+        for u in range(12):
+            events.append(ev("$set", "user", f"u{u}"))
+        for i in range(8):
+            cat = "electronics" if i < 4 else "books"
+            events.append(ev("$set", "item", f"i{i}",
+                             props={"categories": [cat]}))
+        # group A users view items 0-3, group B views 4-7
+        for u in range(12):
+            lo, hi = (0, 4) if u < 6 else (4, 8)
+            for _ in range(6):
+                events.append(ev("view", "user", f"u{u}", "item",
+                                 f"i{rng.integers(lo, hi)}"))
+        le.insert_batch(events, aid)
+        return aid
+
+    def make_engine_and_params(self, rank=8, iters=5):
+        from predictionio_tpu.templates.similarproduct import (
+            ALSAlgorithmParams, DataSourceParams, engine_factory)
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="simapp")),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=rank, num_iterations=iters,
+                                           seed=0))],
+        )
+        return engine, params
+
+    def test_similar_items_same_group(self, app):
+        from predictionio_tpu.templates.similarproduct import Query
+
+        engine, params = self.make_engine_and_params()
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        result = algo.predict(model, Query(items=("i0",), num=3))
+        assert result.item_scores
+        # most similar items co-viewed with i0 are from the same group
+        top = result.item_scores[0]
+        assert top.item in {"i1", "i2", "i3"}
+        assert "i0" not in {s.item for s in result.item_scores}
+
+    def test_filters(self, app):
+        from predictionio_tpu.templates.similarproduct import Query
+
+        engine, params = self.make_engine_and_params()
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+
+        r = algo.predict(model, Query(items=("i0",), num=8,
+                                      categories=("books",)))
+        assert all(s.item in {"i4", "i5", "i6", "i7"}
+                   for s in r.item_scores)
+
+        r = algo.predict(model, Query(items=("i0",), num=8,
+                                      white_list=("i1", "i2")))
+        assert {s.item for s in r.item_scores} <= {"i1", "i2"}
+
+        r = algo.predict(model, Query(items=("i0",), num=8,
+                                      black_list=("i1",)))
+        assert "i1" not in {s.item for s in r.item_scores}
+
+        # unknown query item -> empty
+        assert algo.predict(model, Query(items=("zzz",))).item_scores == ()
+
+    def test_view_of_unknown_entity_skipped(self, mem_storage):
+        from predictionio_tpu.templates.similarproduct import (
+            EventDataSource, DataSourceParams)
+        aid = make_app("simapp")
+        le = storage.get_levents()
+        le.insert_batch([
+            ev("$set", "user", "u0"),
+            ev("$set", "item", "i0"),
+            ev("view", "user", "u0", "item", "i0"),
+            ev("view", "user", "ghost", "item", "i0"),
+        ], aid)
+        ds = EventDataSource(DataSourceParams(app_name="simapp"))
+        td = ds.read_training_base(CTX)
+        assert len(td.view_events) == 2  # both rows read; ghost dropped at train
+
+
+# ---------------------------------------------------------------------------
+# ecommercerecommendation
+# ---------------------------------------------------------------------------
+
+class TestECommerceTemplate:
+    @pytest.fixture
+    def app(self, mem_storage):
+        aid = make_app("ecomapp")
+        le = storage.get_levents()
+        rng = np.random.default_rng(2)
+        events = []
+        for u in range(10):
+            events.append(ev("$set", "user", f"u{u}"))
+        for i in range(8):
+            cat = "phones" if i < 4 else "laptops"
+            events.append(ev("$set", "item", f"i{i}",
+                             props={"categories": [cat]}))
+        for u in range(10):
+            lo, hi = (0, 4) if u < 5 else (4, 8)
+            for _ in range(5):
+                events.append(ev("view", "user", f"u{u}", "item",
+                                 f"i{rng.integers(lo, hi)}"))
+            events.append(ev("buy", "user", f"u{u}", "item", f"i{lo}"))
+        le.insert_batch(events, aid)
+        return aid
+
+    def make_engine_and_params(self, rank=8, **kw):
+        from predictionio_tpu.templates.ecommercerecommendation import (
+            DataSourceParams, ECommAlgorithmParams, engine_factory)
+        engine = engine_factory()
+        algo_params = ECommAlgorithmParams(
+            app_name="ecomapp", rank=rank, num_iterations=10, seed=0, **kw)
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="ecomapp")),
+            algorithm_params_list=[("als", algo_params)],
+        )
+        return engine, params
+
+    def test_recommends_own_group(self, app):
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        engine, params = self.make_engine_and_params()
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        r = algo.predict(model, Query(user="u1", num=3))
+        assert r.item_scores
+        assert {s.item for s in r.item_scores} <= {f"i{i}" for i in range(4)}
+
+    def test_unavailable_items_filtered_live(self, app):
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        engine, params = self.make_engine_and_params()
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        r = algo.predict(model, Query(user="u1", num=8))
+        top_before = {s.item for s in r.item_scores}
+        assert top_before
+
+        # business rule arrives AFTER training: a $set on the constraint
+        # entity immediately affects predictions
+        aid = storage.get_metadata_apps().get_by_name("ecomapp").id
+        storage.get_levents().insert(
+            ev("$set", "constraint", "unavailableItems",
+               props={"items": sorted(top_before)}), aid)
+        r2 = algo.predict(model, Query(user="u1", num=8))
+        assert not ({s.item for s in r2.item_scores} & top_before)
+
+    def test_unseen_only(self, app):
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        engine, params = self.make_engine_and_params(
+            unseen_only=True, seen_events=("buy", "view"))
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        aid = storage.get_metadata_apps().get_by_name("ecomapp").id
+        seen = {e.target_entity_id for e in storage.get_levents().find(
+            app_id=aid, entity_type="user", entity_id="u1",
+            event_names=["view", "buy"])}
+        r = algo.predict(model, Query(user="u1", num=8))
+        assert not ({s.item for s in r.item_scores} & seen)
+
+    def test_unknown_user_recent_view_fallback(self, app):
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        # low rank so the two co-view groups separate cleanly in cosine
+        engine, params = self.make_engine_and_params(rank=2)
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+
+        # no user feature, no events -> empty
+        assert algo.predict(model, Query(user="stranger")).item_scores == ()
+
+        # stranger views a laptop AFTER training -> laptop-like recs
+        aid = storage.get_metadata_apps().get_by_name("ecomapp").id
+        storage.get_levents().insert(
+            ev("view", "user", "stranger", "item", "i5"), aid)
+        r = algo.predict(model, Query(user="stranger", num=3,
+                                      black_list=("i5",)))
+        assert r.item_scores
+        assert {s.item for s in r.item_scores} <= {"i4", "i6", "i7"}
+
+    def test_category_filter(self, app):
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        engine, params = self.make_engine_and_params()
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        r = algo.predict(model, Query(user="u1", num=8,
+                                      categories=("laptops",)))
+        assert all(s.item in {"i4", "i5", "i6", "i7"}
+                   for s in r.item_scores)
