@@ -82,6 +82,16 @@ class StringIndexBiMap(BiMap):
         super().__init__({k: i for i, k in enumerate(ordered)})
         self._labels = np.asarray(ordered, dtype=object)
 
+    @classmethod
+    def from_distinct(cls, labels: Sequence[str]) -> "StringIndexBiMap":
+        """Build from already-distinct labels without re-deduplicating —
+        the vectorized path used by ColumnarEvents.encode_entities, where
+        ``np.unique`` has produced the distinct set already."""
+        self = cls.__new__(cls)
+        BiMap.__init__(self, {str(k): i for i, k in enumerate(labels)})
+        self._labels = np.asarray([str(k) for k in labels], dtype=object)
+        return self
+
     @property
     def labels(self) -> np.ndarray:
         """Object ndarray such that labels[i] == key with index i."""

@@ -1,0 +1,140 @@
+"""Columnar event batches — the TPU ingest format.
+
+The reference's training reads return ``RDD[Event]`` (``PEvents.scala:77-86``)
+and every template immediately re-shapes them into numeric triples for MLlib
+(``examples/scala-parallel-recommendation/custom-query/src/main/scala/
+DataSource.scala:31-65``). On a TPU host that per-row object path is the
+ingest bottleneck (SURVEY hard part #2), so the data plane's canonical bulk
+read is a struct-of-arrays batch instead: entity/target IDs as numpy object
+arrays, one extracted numeric property column, and event times — everything
+downstream (BiMap indexing, padding, ``jax.device_put``) is vectorized.
+
+Backends may build these straight from their native scan (see
+``SqlitePEvents.find_columnar`` which extracts the value column inside SQL);
+``events_to_columnar`` is the generic fallback and also the conformance
+oracle the backend fast paths are tested against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+import numpy as np
+
+from predictionio_tpu.data.event import Event
+
+
+@dataclasses.dataclass
+class ColumnarEvents:
+    """Struct-of-arrays view of an event scan, aligned by row.
+
+    ``entity_ids``/``target_ids`` are object arrays (``target_ids`` entries
+    may be None for events without a target); ``values`` is the extracted
+    numeric property (``default_value`` where absent or non-numeric);
+    ``event_times`` is float64 epoch seconds (UTC).
+    """
+
+    entity_ids: np.ndarray   # object [n]
+    target_ids: np.ndarray   # object [n]
+    values: np.ndarray       # float32 [n]
+    event_times: np.ndarray  # float64 [n] epoch seconds
+    events: Optional[np.ndarray] = None  # object [n] event names (optional)
+
+    def __len__(self) -> int:
+        return int(self.entity_ids.shape[0])
+
+    def encode_entities(self):
+        """Vectorized dense indexing of both ID columns.
+
+        Returns ``(user_map, item_map, rows, cols)`` where the maps are
+        :class:`~predictionio_tpu.data.bimap.StringIndexBiMap` over the
+        distinct IDs (sorted) and ``rows``/``cols`` are int64 dense codes —
+        the BiMap.stringInt step of every template, done with two
+        ``np.unique`` calls instead of per-row dict lookups.
+
+        Raises ``ValueError`` if any row has no target entity (a phantom
+        "None" item must never get a matrix column); filter the scan by
+        ``target_entity_type`` or call :meth:`drop_missing_targets` first.
+        """
+        from predictionio_tpu.data.bimap import StringIndexBiMap
+
+        missing = np.fromiter((x is None for x in self.target_ids),
+                              dtype=bool, count=len(self.target_ids))
+        if missing.any():
+            raise ValueError(
+                f"{int(missing.sum())} events have no target entity; filter "
+                "by target_entity_type or use drop_missing_targets() before "
+                "encode_entities()")
+        ent = self.entity_ids.astype(str)
+        tgt = self.target_ids.astype(str)
+        e_labels, rows = np.unique(ent, return_inverse=True)
+        t_labels, cols = np.unique(tgt, return_inverse=True)
+        return (StringIndexBiMap.from_distinct(e_labels),
+                StringIndexBiMap.from_distinct(t_labels),
+                rows.astype(np.int64), cols.astype(np.int64))
+
+    def drop_missing_targets(self) -> "ColumnarEvents":
+        """Rows with a target entity only (aligned across all columns)."""
+        keep = np.fromiter((x is not None for x in self.target_ids),
+                           dtype=bool, count=len(self.target_ids))
+        return ColumnarEvents(
+            entity_ids=self.entity_ids[keep],
+            target_ids=self.target_ids[keep],
+            values=self.values[keep],
+            event_times=self.event_times[keep],
+            events=None if self.events is None else self.events[keep],
+        )
+
+
+def empty_columnar() -> ColumnarEvents:
+    return ColumnarEvents(
+        entity_ids=np.empty(0, dtype=object),
+        target_ids=np.empty(0, dtype=object),
+        values=np.empty(0, dtype=np.float32),
+        event_times=np.empty(0, dtype=np.float64),
+        events=np.empty(0, dtype=object),
+    )
+
+
+def events_to_columnar(events: Iterable[Event],
+                       value_property: Optional[str] = None,
+                       default_value: float = 1.0,
+                       strict: bool = True) -> ColumnarEvents:
+    """Generic Event-objects -> columnar conversion (backend fallback).
+
+    ``value_property`` names the DataMap field to extract as the value
+    column (e.g. ``"rating"``); rows without it (or with JSON null) get
+    ``default_value`` — the template convention where a ``view`` event
+    counts as an implicit 1.0 (``DataSource.scala:44-56``). A present but
+    non-numeric value (string, bool, list, ...) raises ``ValueError`` when
+    ``strict`` (matching ``DataMap.get(name, float)``'s loud failure);
+    ``strict=False`` maps it to ``default_value``.
+    """
+    ents, tgts, vals, times, names = [], [], [], [], []
+    for e in events:
+        ents.append(e.entity_id)
+        tgts.append(e.target_entity_id)
+        times.append(e.event_time.timestamp())
+        names.append(e.event)
+        v = default_value
+        if value_property is not None and value_property in e.properties:
+            raw = e.properties[value_property]
+            if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+                v = float(raw)
+            elif raw is not None and strict:
+                raise ValueError(
+                    f"property {value_property!r} of event "
+                    f"{e.event_id or e.event!r} is non-numeric: {raw!r}")
+        vals.append(v)
+    n = len(ents)
+    return ColumnarEvents(
+        entity_ids=np.asarray(ents, dtype=object) if n
+        else np.empty(0, dtype=object),
+        target_ids=np.asarray(tgts, dtype=object) if n
+        else np.empty(0, dtype=object),
+        values=np.asarray(vals, dtype=np.float32),
+        event_times=np.asarray(times, dtype=np.float64),
+        events=np.asarray(names, dtype=object) if n
+        else np.empty(0, dtype=object),
+    )

@@ -158,7 +158,16 @@ class DataMap(Mapping[str, Any]):
 
     # -- serialization ----------------------------------------------------
     def to_json(self) -> str:
-        return json.dumps(self._fields, sort_keys=True, default=_json_default)
+        # allow_nan=False: NaN/Infinity are not JSON; letting them through
+        # would poison every downstream JSON consumer (sqlite json_extract
+        # aborts whole scans on a single malformed row)
+        try:
+            return json.dumps(self._fields, sort_keys=True,
+                              default=_json_default, allow_nan=False)
+        except ValueError as e:
+            raise DataMapError(
+                f"properties contain a non-JSON number (NaN/Infinity): {e}"
+            ) from e
 
     @classmethod
     def from_json(cls, s: str) -> "DataMap":

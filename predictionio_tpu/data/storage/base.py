@@ -154,6 +154,33 @@ class PEvents(abc.ABC):
     def delete(self, event_ids: Iterable[str], app_id: int,
                channel_id: Optional[int] = None) -> None: ...
 
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        value_property: Optional[str] = None,
+        default_value: float = 1.0,
+        strict: bool = True,
+    ):
+        """Bulk scan as a struct-of-arrays batch — the TPU ingest format
+        (see ``predictionio_tpu.data.columnar``). Default implementation
+        materializes Events then columnizes; backends override with a
+        native scan that never builds per-row Python objects."""
+        from predictionio_tpu.data.columnar import events_to_columnar
+
+        return events_to_columnar(
+            self.find(app_id=app_id, channel_id=channel_id,
+                      start_time=start_time, until_time=until_time,
+                      entity_type=entity_type, event_names=event_names,
+                      target_entity_type=target_entity_type),
+            value_property=value_property, default_value=default_value,
+            strict=strict)
+
     def aggregate_properties(
         self,
         app_id: int,

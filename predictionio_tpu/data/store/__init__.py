@@ -74,6 +74,30 @@ class PEventStore:
             app_id=app_id, entity_type=entity_type, channel_id=channel_id,
             start_time=start_time, until_time=until_time, required=required)
 
+    @staticmethod
+    def find_columnar(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        value_property: Optional[str] = None,
+        default_value: float = 1.0,
+        strict: bool = True,
+    ):
+        """Struct-of-arrays bulk read — the TPU ingest path (no reference
+        analog; replaces RDD[Event] + per-template reshaping with one
+        vectorized scan, see data/columnar.py)."""
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        return storage.get_pevents().find_columnar(
+            app_id=app_id, channel_id=channel_id, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            event_names=event_names, target_entity_type=target_entity_type,
+            value_property=value_property, default_value=default_value,
+            strict=strict)
+
 
 class LEventStore:
     """Low-latency reads at predict time (LEventStore.scala:58,114).

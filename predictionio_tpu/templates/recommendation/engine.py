@@ -61,36 +61,78 @@ class Rating:
     rating: float
 
 
-@dataclasses.dataclass
 class TrainingData:
-    ratings: List[Rating]
+    """Columnar rating triples (users/items as object arrays, float32
+    values) — the TPU ingest format. Accepts a ``Rating`` list for parity
+    with the reference template's ``TrainingData(ratings: RDD[Rating])``
+    (``DataSource.scala:62-65``); ``.ratings`` materializes lazily."""
+
+    def __init__(self, ratings: Optional[List[Rating]] = None, *,
+                 users: Optional[np.ndarray] = None,
+                 items: Optional[np.ndarray] = None,
+                 values: Optional[np.ndarray] = None):
+        if ratings is not None:
+            n = len(ratings)
+            users = np.asarray([r.user for r in ratings], dtype=object)
+            items = np.asarray([r.item for r in ratings], dtype=object)
+            values = np.fromiter((r.rating for r in ratings),
+                                 dtype=np.float32, count=n)
+        self.users = users if users is not None \
+            else np.empty(0, dtype=object)
+        self.items = items if items is not None \
+            else np.empty(0, dtype=object)
+        self.values = values if values is not None \
+            else np.empty(0, dtype=np.float32)
+        if not (len(self.users) == len(self.items) == len(self.values)):
+            raise ValueError(
+                f"misaligned rating columns: {len(self.users)} users, "
+                f"{len(self.items)} items, {len(self.values)} values")
+        # a None id would become the literal string 'None' at indexing time
+        # and train a phantom row/column (cf. ColumnarEvents.encode_entities)
+        for name, col in (("user", self.users), ("item", self.items)):
+            if any(x is None for x in col):
+                raise ValueError(
+                    f"TrainingData has events without a {name} id; filter "
+                    "the event scan (e.g. by target_entity_type)")
+        self._ratings: Optional[List[Rating]] = ratings
+
+    @property
+    def ratings(self) -> List[Rating]:
+        if self._ratings is None:
+            self._ratings = [
+                Rating(str(u), str(i), float(v))
+                for u, i, v in zip(self.users, self.items, self.values)]
+        return self._ratings
+
+    def __len__(self) -> int:
+        return int(self.users.shape[0])
 
     def sanity_check(self) -> None:
-        assert self.ratings, (
+        assert len(self), (
             "ratings in TrainingData cannot be empty. Please check if "
             "DataSource generates TrainingData correctly.")
 
 
 class EventDataSource(PDataSource):
     """Reads rating events (DataSource.scala:31-65): rate -> property
-    'rating', view -> implicit count of 1."""
+    'rating', view -> implicit count of 1. Uses the columnar bulk-read
+    path so no per-event Python objects are built."""
 
     params_class = DataSourceParams
 
     def read_training(self, ctx: ComputeContext) -> TrainingData:
         p: DataSourceParams = self.params
-        events = PEventStore.find(
+        batch = PEventStore.find_columnar(
             app_name=p.app_name,
             channel_name=p.channel_name,
             entity_type="user",
             event_names=list(p.event_names),
             target_entity_type="item",
+            value_property="rating",
+            default_value=1.0,
         )
-        ratings = []
-        for e in events:
-            rating = e.properties.get("rating", float, 1.0)
-            ratings.append(Rating(e.entity_id, e.target_entity_id, rating))
-        return TrainingData(ratings)
+        return TrainingData(users=batch.entity_ids, items=batch.target_ids,
+                            values=batch.values)
 
     def read_eval(self, ctx: ComputeContext):
         """k-fold style eval: hold out every k-th rating per user as the
@@ -167,19 +209,22 @@ class RatingsPreparator(PPreparator):
     proper Preparator so multiple algorithms share the layout)."""
 
     def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
-        user_map = BiMap.string_int(r.user for r in td.ratings)
-        item_map = BiMap.string_int(r.item for r in td.ratings)
+        u_labels, rows = np.unique(td.users.astype(str), return_inverse=True)
+        i_labels, cols = np.unique(td.items.astype(str), return_inverse=True)
+        user_map = StringIndexBiMap.from_distinct(u_labels)
+        item_map = StringIndexBiMap.from_distinct(i_labels)
         n_u, n_i = len(user_map), len(item_map)
-        rows = np.fromiter((user_map[r.user] for r in td.ratings),
-                           dtype=np.int64, count=len(td.ratings))
-        cols = np.fromiter((item_map[r.item] for r in td.ratings),
-                           dtype=np.int64, count=len(td.ratings))
-        vals = np.fromiter((r.rating for r in td.ratings),
-                           dtype=np.float32, count=len(td.ratings))
+        rows = rows.astype(np.int64)
+        cols = cols.astype(np.int64)
+        vals = np.asarray(td.values, dtype=np.float32)
         user_side = pad_ratings(rows, cols, vals, n_u, n_i)
         item_side = pad_ratings(cols, rows, vals, n_i, n_u)
-        seen = {u: cols[rows == u].astype(np.int64)
-                for u in range(n_u)}
+        # per-user seen-item lists via one stable sort (vs n_u boolean scans)
+        order = np.argsort(rows, kind="stable")
+        s_rows, s_cols = rows[order], cols[order]
+        starts = np.searchsorted(s_rows, np.arange(n_u))
+        ends = np.searchsorted(s_rows, np.arange(n_u), side="right")
+        seen = {u: s_cols[starts[u]:ends[u]] for u in range(n_u)}
         return PreparedData(user_map, item_map, user_side, item_side, seen)
 
 

@@ -270,14 +270,17 @@ class QueryServer:
     def _serve_one(self, dep: _Deployment,
                    query_dict: Mapping[str, Any]) -> Tuple[Any, Any]:
         query = self._extract_query(dep, query_dict)
+        return query, self._predict(dep, query)
+
+    @staticmethod
+    def _predict(dep: _Deployment, query: Any) -> Any:
         supplemented = dep.serving.supplement_base(query)
         predictions = [
             algo.predict_base(model, supplemented)
             for algo, model in zip(dep.algorithms, dep.models)
         ]
         # by design: serve with the *original* query (scala :538-540)
-        prediction = dep.serving.serve_base(query, predictions)
-        return query, prediction
+        return dep.serving.serve_base(query, predictions)
 
     @staticmethod
     def _extract_query(dep: _Deployment,
@@ -303,12 +306,7 @@ class QueryServer:
             logger.error("Query %r is invalid. Reason: %s", query_dict, e)
             return 400, {"message": str(e)}
         try:
-            supplemented = dep.serving.supplement_base(query)
-            predictions = [
-                algo.predict_base(model, supplemented)
-                for algo, model in zip(dep.algorithms, dep.models)
-            ]
-            prediction = dep.serving.serve_base(query, predictions)
+            prediction = self._predict(dep, query)
         except Exception as e:
             logger.exception("query failed")
             return 500, {"message": str(e)}

@@ -1,0 +1,168 @@
+"""Columnar event-batch (TPU ingest) path: backend fast paths vs the
+generic Event-object oracle, vectorized entity encoding, and the
+recommendation DataSource wiring."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.columnar import events_to_columnar
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import LEventsBackedPEvents
+from predictionio_tpu.data.storage.memory import MemLEvents
+from predictionio_tpu.data.storage.sqlite import SqliteLEvents, SqlitePEvents
+
+UTC = dt.timezone.utc
+APP = 1
+
+
+def t(i):
+    return dt.datetime(2021, 3, 1, 0, 0, i, tzinfo=UTC)
+
+
+def rate(i, user, item, rating=None, name="rate"):
+    props = {} if rating is None else {"rating": rating}
+    return Event(event=name, entity_type="user", entity_id=user,
+                 target_entity_type="item", target_entity_id=item,
+                 properties=DataMap(props), event_time=t(i))
+
+
+EVENTS = [
+    rate(1, "u1", "i1", 4.0),
+    rate(2, "u2", "i1", 2.5),
+    rate(3, "u1", "i2", 5),           # int rating
+    rate(4, "u3", "i3"),              # no rating property -> default
+    rate(6, "u1", "i3", 1.0, name="view"),
+    Event(event="$set", entity_type="user", entity_id="u9",
+          properties=DataMap({"rating": 9.0}), event_time=t(7)),
+]
+
+BAD_EVENTS = [
+    rate(8, "u2", "i2", True),        # boolean is NOT numeric
+    rate(9, "u3", "i1", "4.5"),       # string is NOT numeric
+]
+
+
+@pytest.fixture(params=["sqlite", "memory"])
+def pevents(request, tmp_path):
+    if request.param == "sqlite":
+        dao = SqlitePEvents({"path": str(tmp_path / "col.db")})
+        dao._l.init(APP)
+        dao._l.insert_batch(EVENTS, APP)
+        yield dao
+        dao.shutdown()
+    else:
+        lev = MemLEvents({})
+        lev.init(APP)
+        lev.insert_batch(EVENTS, APP)
+        yield LEventsBackedPEvents(lev)
+
+
+class TestFindColumnar:
+    def test_matches_oracle(self, pevents):
+        got = pevents.find_columnar(
+            APP, entity_type="user", event_names=["rate", "view"],
+            target_entity_type="item", value_property="rating",
+            default_value=1.0)
+        want = events_to_columnar(
+            [e for e in EVENTS if e.event in ("rate", "view")],
+            value_property="rating", default_value=1.0)
+        assert list(got.entity_ids) == list(want.entity_ids)
+        assert list(got.target_ids) == list(want.target_ids)
+        np.testing.assert_allclose(got.values, want.values)
+        np.testing.assert_allclose(got.event_times, want.event_times)
+        assert list(got.events) == list(want.events)
+
+    def test_value_extraction(self, pevents):
+        got = pevents.find_columnar(
+            APP, event_names=["rate"], value_property="rating",
+            default_value=-7.0)
+        # order is event_time ascending
+        np.testing.assert_allclose(got.values, [4.0, 2.5, 5.0, -7.0])
+
+    def test_no_value_property(self, pevents):
+        got = pevents.find_columnar(APP, event_names=["rate"],
+                                    default_value=3.0)
+        np.testing.assert_allclose(got.values, np.full(4, 3.0))
+
+    def test_non_numeric_strict_raises(self, pevents):
+        # bool/string property values fail loudly (DataMap.get float parity)
+        pevents.write(BAD_EVENTS, APP)
+        with pytest.raises(ValueError, match="non-numeric"):
+            pevents.find_columnar(APP, event_names=["rate"],
+                                  value_property="rating")
+        got = pevents.find_columnar(APP, event_names=["rate"],
+                                    value_property="rating",
+                                    default_value=0.5, strict=False)
+        np.testing.assert_allclose(
+            got.values, [4.0, 2.5, 5.0, 0.5, 0.5, 0.5])
+
+    def test_time_filter(self, pevents):
+        got = pevents.find_columnar(APP, start_time=t(2), until_time=t(4),
+                                    event_names=["rate"])
+        assert list(got.entity_ids) == ["u2", "u1"]
+
+    def test_empty(self, pevents):
+        got = pevents.find_columnar(APP, event_names=["nosuch"])
+        assert len(got) == 0
+        assert got.values.dtype == np.float32
+
+
+class TestEncodeEntities:
+    def test_dense_codes_roundtrip(self, pevents):
+        batch = pevents.find_columnar(APP, event_names=["rate", "view"],
+                                      value_property="rating")
+        user_map, item_map, rows, cols = batch.encode_entities()
+        assert len(user_map) == 3 and len(item_map) == 3
+        # codes decode back to the original ids
+        assert list(user_map.decode(rows)) == list(batch.entity_ids)
+        assert list(item_map.decode(cols)) == list(batch.target_ids)
+        # forward dict agrees with the codes
+        for uid, code in zip(batch.entity_ids, rows):
+            assert user_map[str(uid)] == int(code)
+
+    def test_missing_targets_raise(self, pevents):
+        batch = pevents.find_columnar(APP)  # includes the $set event
+        with pytest.raises(ValueError, match="no target entity"):
+            batch.encode_entities()
+        filtered = batch.drop_missing_targets()
+        assert len(filtered) == len(batch) - 1
+        filtered.encode_entities()  # no phantom "None" item
+
+
+class TestTemplateWiring:
+    def test_datasource_columnar(self, mem_storage):
+        from predictionio_tpu.core.context import ComputeContext
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.templates.recommendation.engine import (
+            DataSourceParams, EventDataSource, TrainingData,
+        )
+
+        storage.get_metadata_apps().insert(App(0, "colapp"))
+        lev = storage.get_levents()
+        app = storage.get_metadata_apps().get_by_name("colapp")
+        lev.init(app.id)
+        lev.insert_batch([rate(i, f"u{i % 3}", f"i{i % 4}", float(i % 5) + 1)
+                          for i in range(12)], app.id)
+
+        ds = EventDataSource(DataSourceParams(app_name="colapp"))
+        td = ds.read_training(ComputeContext())
+        assert isinstance(td, TrainingData)
+        assert len(td) == 12
+        assert td.values.dtype == np.float32
+        # lazy Rating materialization parity
+        rs = td.ratings
+        assert rs[0].user == td.users[0] and rs[0].rating == td.values[0]
+
+    def test_trainingdata_from_ratings(self):
+        from predictionio_tpu.templates.recommendation.engine import (
+            Rating, TrainingData,
+        )
+
+        td = TrainingData([Rating("u1", "i1", 2.0), Rating("u2", "i2", 3.0)])
+        assert len(td) == 2
+        assert list(td.users) == ["u1", "u2"]
+        np.testing.assert_allclose(td.values, [2.0, 3.0])
