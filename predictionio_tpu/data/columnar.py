@@ -87,16 +87,6 @@ class ColumnarEvents:
         )
 
 
-def empty_columnar() -> ColumnarEvents:
-    return ColumnarEvents(
-        entity_ids=np.empty(0, dtype=object),
-        target_ids=np.empty(0, dtype=object),
-        values=np.empty(0, dtype=np.float32),
-        event_times=np.empty(0, dtype=np.float64),
-        events=np.empty(0, dtype=object),
-    )
-
-
 def events_to_columnar(events: Iterable[Event],
                        value_property: Optional[str] = None,
                        default_value: float = 1.0,

@@ -206,6 +206,24 @@ def validate_event(e: Event) -> None:
             not is_reserved_prefix(k) or k in BUILTIN_PROPERTIES,
             f"The property {k} is not allowed. 'pio_' is a reserved name prefix.",
         )
+    _req_json_numbers(e.properties.fields)
+
+
+def _req_json_numbers(v: Any) -> None:
+    """NaN/Infinity are not JSON; json.loads accepts them as an extension
+    but letting them into the store would fail at serialization time (and
+    poison sqlite json_extract scans) — reject at validation instead so
+    the API returns 400, not a 500 deep in the insert path."""
+    if isinstance(v, float):
+        if v != v or v in (float("inf"), float("-inf")):
+            raise EventValidationError(
+                f"property values must be JSON numbers; got {v!r}")
+    elif isinstance(v, dict):
+        for x in v.values():
+            _req_json_numbers(x)
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            _req_json_numbers(x)
 
 
 def new_event_id() -> str:

@@ -380,6 +380,24 @@ class SqliteLEvents(base.LEvents):
                 " creation_time) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows)
         return ids
 
+    def insert_raw_batch(self, rows: List[tuple], app_id: int,
+                         channel_id: Optional[int] = None) -> None:
+        """Pre-validated columnar insert for the native import path: rows
+        are (event_id, event, entity_type, entity_id, target_entity_type,
+        target_entity_id, properties_json, event_time_epoch_sec,
+        tags_json, pr_id, creation_time_epoch_sec) — app/channel encoding
+        stays the backend's business. Callers (tools/export_import) are
+        responsible for validation — this is the data-plane fast lane,
+        not the API."""
+        aid, chan = int(app_id), self._chan(channel_id)
+        full = [(r[0], aid, chan) + r[1:] for r in rows]
+        with self._client.tx() as c:
+            c.executemany(
+                "INSERT OR REPLACE INTO events (event_id, app_id, channel_id,"
+                " event, entity_type, entity_id, target_entity_type,"
+                " target_entity_id, properties, event_time, tags, pr_id,"
+                " creation_time) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", full)
+
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
         row = self._client.query_one(
             f"SELECT {_EVENT_COLS} FROM events WHERE app_id=? AND channel_id=?"

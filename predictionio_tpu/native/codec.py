@@ -1,0 +1,173 @@
+"""ctypes wrapper for the native JSON-lines event codec.
+
+``parse_jsonl`` returns a :class:`ParsedEvents` batch: per-field python
+string lists (None where absent), epoch-second time arrays, and
+per-row validation facts pre-computed in C++. Rows the native parser
+could not express 1:1 with python semantics carry ``FALLBACK`` and are
+re-parsed by the caller with ``Event.from_json`` — so the codec is
+always behavior-identical to the python path, only faster.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from predictionio_tpu import native
+
+# column ids — keep in sync with src/jsonl_codec.cpp
+COL_EVENT = 0
+COL_ENTITY_TYPE = 1
+COL_ENTITY_ID = 2
+COL_TARGET_ENTITY_TYPE = 3
+COL_TARGET_ENTITY_ID = 4
+COL_PROPERTIES = 5
+COL_TAGS = 6
+COL_PR_ID = 7
+COL_EVENT_ID = 8
+COL_EVENT_TIME_RAW = 9
+COL_CREATION_TIME_RAW = 10
+COL_BAD_PROP_KEY = 11
+
+FALLBACK = 1
+PROPS_EMPTY = 2
+BAD_PROP_KEY = 4
+
+
+@dataclasses.dataclass
+class ParsedEvents:
+    """One parsed file: aligned per-row columns."""
+
+    event: List[Optional[str]]
+    entity_type: List[Optional[str]]
+    entity_id: List[Optional[str]]
+    target_entity_type: List[Optional[str]]
+    target_entity_id: List[Optional[str]]
+    properties_json: List[Optional[str]]   # raw JSON object text
+    tags_json: List[Optional[str]]         # raw JSON array text
+    pr_id: List[Optional[str]]
+    event_id: List[Optional[str]]
+    event_time_raw: List[Optional[str]]
+    creation_time_raw: List[Optional[str]]
+    bad_prop_key: List[Optional[str]]
+    event_time: np.ndarray       # float64 epoch sec; NaN = absent/unparsed
+    creation_time: np.ndarray
+    flags: np.ndarray            # uint8 bitmask per row
+    lineno: np.ndarray           # int64 1-based source line numbers
+    line_start: np.ndarray       # raw-buffer byte spans (fallback re-parse)
+    line_end: np.ndarray
+
+    def __len__(self) -> int:
+        return len(self.event)
+
+
+def _lib():
+    lib = native.load("jsonl_codec")
+    # signatures must be (re)applied per CDLL instance — a module-level
+    # flag would leave a freshly reloaded handle with the default c_int
+    # restype and truncate 64-bit pointers
+    if lib is not None and not getattr(lib, "_pio_sigs", False):
+        lib.pio_jsonl_parse.restype = ctypes.c_void_p
+        lib.pio_jsonl_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.pio_jsonl_count.restype = ctypes.c_int64
+        lib.pio_jsonl_count.argtypes = [ctypes.c_void_p]
+        lib.pio_jsonl_col_bytes.restype = ctypes.c_int64
+        lib.pio_jsonl_col_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.pio_jsonl_col_fill.restype = None
+        lib.pio_jsonl_col_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8)]
+        lib.pio_jsonl_times.restype = None
+        lib.pio_jsonl_times.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double)]
+        lib.pio_jsonl_flags.restype = None
+        lib.pio_jsonl_flags.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_uint8)]
+        lib.pio_jsonl_lines.restype = None
+        lib.pio_jsonl_lines.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.pio_jsonl_free.restype = None
+        lib.pio_jsonl_free.argtypes = [ctypes.c_void_p]
+        lib._pio_sigs = True
+    return lib
+
+
+def is_available() -> bool:
+    return _lib() is not None
+
+
+def _col(lib, handle, col: int, n: int) -> List[Optional[str]]:
+    nbytes = lib.pio_jsonl_col_bytes(handle, col)
+    data = ctypes.create_string_buffer(max(1, nbytes))
+    offsets = np.empty(n + 1, dtype=np.int64)
+    present = np.empty(n, dtype=np.uint8)
+    lib.pio_jsonl_col_fill(
+        handle, col, data,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        present.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    out: List[Optional[str]] = [None] * n
+    idx = np.nonzero(present)[0]
+    if len(idx) == 0:
+        return out
+    blob = data.raw[:nbytes].decode("utf-8")
+    # offsets are byte offsets; slice the decoded str directly only when
+    # the blob is pure ASCII (byte offsets == char offsets)
+    if len(blob) == nbytes:
+        off = offsets
+        for i in idx:
+            out[i] = blob[off[i]:off[i + 1]]
+    else:
+        raw = data.raw
+        for i in idx:
+            out[i] = raw[offsets[i]:offsets[i + 1]].decode("utf-8")
+    return out
+
+
+def parse_jsonl(data: bytes) -> Optional[ParsedEvents]:
+    """Parse a JSON-lines event buffer natively; None if the native lib
+    is unavailable (callers use the pure-python path then)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    handle = lib.pio_jsonl_parse(data, len(data))
+    try:
+        n = lib.pio_jsonl_count(handle)
+        cols = [_col(lib, handle, c, n) for c in range(12)]
+        et = np.empty(n, dtype=np.float64)
+        ct = np.empty(n, dtype=np.float64)
+        lib.pio_jsonl_times(
+            handle, et.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ct.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        flags = np.empty(n, dtype=np.uint8)
+        lib.pio_jsonl_flags(
+            handle, flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        starts = np.empty(n, dtype=np.int64)
+        ends = np.empty(n, dtype=np.int64)
+        lineno = np.empty(n, dtype=np.int64)
+        lib.pio_jsonl_lines(
+            handle, starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lineno.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        parsed = ParsedEvents(
+            event=cols[COL_EVENT],
+            entity_type=cols[COL_ENTITY_TYPE],
+            entity_id=cols[COL_ENTITY_ID],
+            target_entity_type=cols[COL_TARGET_ENTITY_TYPE],
+            target_entity_id=cols[COL_TARGET_ENTITY_ID],
+            properties_json=cols[COL_PROPERTIES],
+            tags_json=cols[COL_TAGS],
+            pr_id=cols[COL_PR_ID],
+            event_id=cols[COL_EVENT_ID],
+            event_time_raw=cols[COL_EVENT_TIME_RAW],
+            creation_time_raw=cols[COL_CREATION_TIME_RAW],
+            bad_prop_key=cols[COL_BAD_PROP_KEY],
+            event_time=et, creation_time=ct, flags=flags, lineno=lineno,
+            line_start=starts, line_end=ends)
+        return parsed
+    finally:
+        lib.pio_jsonl_free(handle)

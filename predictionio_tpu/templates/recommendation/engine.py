@@ -90,7 +90,9 @@ class TrainingData:
         # a None id would become the literal string 'None' at indexing time
         # and train a phantom row/column (cf. ColumnarEvents.encode_entities)
         for name, col in (("user", self.users), ("item", self.items)):
-            if any(x is None for x in col):
+            missing = np.fromiter((x is None for x in col), dtype=bool,
+                                  count=len(col))
+            if missing.any():
                 raise ValueError(
                     f"TrainingData has events without a {name} id; filter "
                     "the event scan (e.g. by target_entity_type)")

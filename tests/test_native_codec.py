@@ -1,0 +1,262 @@
+"""Native JSONL codec: differential tests against the python Event oracle,
+plus end-to-end import equivalence (native sqlite fast lane vs pure-python
+path on a second store)."""
+
+import datetime as dt
+import json
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event, validate_event
+from predictionio_tpu.native import codec
+
+pytestmark = pytest.mark.skipif(not codec.is_available(),
+                                reason="native toolchain unavailable")
+
+UTC = dt.timezone.utc
+
+
+# A corpus exercising escapes, unicode, optional fields, numeric ids,
+# time formats, nesting, and rows that must fall back.
+CORPUS = [
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "targetEntityType": "item", "targetEntityId": "i1",
+     "properties": {"rating": 4.5}, "eventTime": "2021-06-01T12:30:45.123Z"},
+    {"event": "$set", "entityType": "user", "entityId": "u2",
+     "properties": {"name": "Ann \"quoted\" \\ back\t slash",
+                    "nested": {"a": [1, 2, {"b": None}]},
+                    "uni": "héllo ☃"},
+     "eventTime": "2021-06-01T12:30:45+05:30"},
+    {"event": "view", "entityType": "user", "entityId": "ué",
+     "targetEntityType": "item", "targetEntityId": "i2",
+     "eventTime": 1600000000000},
+    {"event": "buy", "entityType": "user", "entityId": 123,
+     "targetEntityType": "item", "targetEntityId": "i3",
+     "tags": ["a", "b"], "prId": "pr1", "eventId": "deadbeef"},
+    {"event": "$delete", "entityType": "user", "entityId": "u4"},
+    {"event": "like", "entityType": "user", "entityId": "u5",
+     "targetEntityType": "item", "targetEntityId": "i9",
+     "eventTime": "2020-02-29T00:00:00+00:00",
+     "creationTime": "2020-03-01T01:02:03.5+00:00"},
+]
+
+
+def _lines(objs):
+    return ("\n".join(json.dumps(o) for o in objs)).encode("utf-8")
+
+
+def _oracle(objs):
+    return [Event.from_json(json.dumps(o)) for o in objs]
+
+
+class TestDifferential:
+    def test_corpus_matches_oracle(self):
+        parsed = codec.parse_jsonl(_lines(CORPUS))
+        oracle = _oracle(CORPUS)
+        assert len(parsed) == len(oracle)
+        for i, ev in enumerate(oracle):
+            assert not parsed.flags[i] & codec.FALLBACK, f"row {i} fell back"
+            assert parsed.event[i] == ev.event
+            assert parsed.entity_type[i] == ev.entity_type
+            assert parsed.entity_id[i] == ev.entity_id
+            assert parsed.target_entity_type[i] == ev.target_entity_type
+            assert parsed.target_entity_id[i] == ev.target_entity_id
+            assert parsed.pr_id[i] == ev.pr_id
+            # properties raw slice parses to the same dict
+            props = json.loads(parsed.properties_json[i] or "{}")
+            assert props == ev.properties.fields
+            tags = json.loads(parsed.tags_json[i] or "[]")
+            assert tuple(tags) == ev.tags
+            # times: epoch equals the oracle datetime (when parsed natively)
+            if not math.isnan(parsed.event_time[i]):
+                assert parsed.event_time[i] == pytest.approx(
+                    ev.event_time.timestamp(), abs=1e-6)
+            elif "eventTime" in CORPUS[i]:
+                pytest.fail(f"row {i}: eventTime should have parsed")
+
+    def test_fallback_rows(self):
+        bad = [
+            '{"event": "rate"',                       # truncated JSON
+            '["not", "an", "object"]',                # non-object
+            '{"event": null, "entityType": "t", "entityId": "x"}',
+            '{"entityType": "user", "entityId": "u"}',  # missing event
+            '{"event": "e", "entityType": "user", "entityId": "u", '
+            '"properties": "notobj"}',
+            '{"event": "e", "entityType": "user", "entityId": 1.5}',
+        ]
+        parsed = codec.parse_jsonl(("\n".join(bad)).encode())
+        assert all(parsed.flags[i] & codec.FALLBACK for i in range(len(bad)))
+
+    def test_validation_flags(self):
+        lines = [
+            '{"event": "$unset", "entityType": "u", "entityId": "x", '
+            '"properties": {}}',
+            '{"event": "$set", "entityType": "u", "entityId": "x", '
+            '"properties": {"pio_bad": 1}}',
+            '{"event": "$set", "entityType": "u", "entityId": "x", '
+            '"properties": {"$dollar": 1}}',
+        ]
+        p = codec.parse_jsonl(("\n".join(lines)).encode())
+        assert p.flags[0] & codec.PROPS_EMPTY
+        assert p.flags[1] & codec.BAD_PROP_KEY
+        assert p.bad_prop_key[1] == "pio_bad"
+        assert p.flags[2] & codec.BAD_PROP_KEY
+
+    def test_blank_lines_and_lineno(self):
+        data = b'\n{"event":"e","entityType":"t","entityId":"i"}\n\n' \
+               b'{"event":"f","entityType":"t","entityId":"j"}\n'
+        p = codec.parse_jsonl(data)
+        assert len(p) == 2
+        assert list(p.lineno) == [2, 4]
+
+    def test_time_strictness_defers_to_python(self):
+        # dates python rejects must NOT be silently accepted natively
+        lines = [
+            '{"event":"e","entityType":"t","entityId":"i",'
+            '"eventTime":"2021-02-30T00:00:00Z"}',   # invalid date
+            '{"event":"e","entityType":"t","entityId":"i",'
+            '"eventTime":"2021-06-01T23:59:60Z"}',   # leap second
+        ]
+        p = codec.parse_jsonl(("\n".join(lines)).encode())
+        for i in range(2):
+            assert math.isnan(p.event_time[i])
+            assert p.event_time_raw[i] is not None  # python will re-parse
+
+    def test_surrogate_pair(self):
+        line = '{"event":"e","entityType":"t","entityId":"\\ud83d\\ude00"}'
+        p = codec.parse_jsonl(line.encode())
+        assert not p.flags[0] & codec.FALLBACK
+        assert p.entity_id[0] == "\U0001F600"
+
+    def test_lone_surrogate_falls_back(self):
+        line = '{"event":"e","entityType":"t","entityId":"\\ud83d"}'
+        p = codec.parse_jsonl(line.encode())
+        assert p.flags[0] & codec.FALLBACK
+
+
+class TestImportEquivalence:
+    def _events_roundtrip(self, tmp_path, monkeypatch, objs):
+        """Import via native path (sqlite) and python path (PIO_NATIVE_
+        DISABLE), compare full event sets."""
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.tools.export_import import import_events
+
+        path = tmp_path / "events.jsonl"
+        path.write_bytes(_lines(objs))
+
+        results = []
+        for disable in ("0", "1"):
+            monkeypatch.setenv("PIO_NATIVE_DISABLE", disable)
+            # force codec re-resolution
+            from predictionio_tpu import native as native_pkg
+            native_pkg._cache.clear()
+            monkeypatch.setenv("PIO_STORAGE_SOURCES_PIO_TYPE", "sqlite")
+            monkeypatch.setenv("PIO_STORAGE_SOURCES_PIO_PATH",
+                               str(tmp_path / f"s{disable}.db"))
+            monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE",
+                               "PIO")
+            monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE",
+                               "PIO")
+            monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE",
+                               "PIO")
+            storage.reset()
+            storage.get_metadata_apps().insert(App(0, "impapp"))
+            rc = import_events(str(path), app_name="impapp")
+            assert rc == 0
+            evs = list(storage.get_levents().find(app_id=1))
+            # rows without an explicit eventTime get stamped with "now" at
+            # import — exclude those times from the equality check
+            timed = {(o["entityId"] if isinstance(o["entityId"], str)
+                      else str(o["entityId"]))
+                     for o in objs if "eventTime" in o}
+            results.append({
+                (e.event, e.entity_type, e.entity_id, e.target_entity_type,
+                 e.target_entity_id, json.dumps(e.properties.fields,
+                                                sort_keys=True),
+                 e.event_time.timestamp() if e.entity_id in timed else None,
+                 e.tags, e.pr_id)
+                for e in evs})
+            storage.reset()
+        native_set, python_set = results
+        assert native_set == python_set
+
+    def test_equivalence(self, tmp_path, monkeypatch):
+        self._events_roundtrip(tmp_path, monkeypatch, CORPUS)
+
+    def test_unset_without_properties_rejected(self, tmp_path, monkeypatch,
+                                               capsys):
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.tools.export_import import import_events
+
+        path = tmp_path / "unset.jsonl"
+        path.write_bytes(
+            b'{"event":"$unset","entityType":"user","entityId":"u1"}\n')
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_PIO_TYPE", "sqlite")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_PIO_PATH",
+                           str(tmp_path / "unset.db"))
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "PIO")
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "PIO")
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "PIO")
+        storage.reset()
+        storage.get_metadata_apps().insert(App(0, "ua"))
+        rc = import_events(str(path), app_name="ua")
+        assert rc == 1
+        assert "properties cannot be empty for $unset" in \
+            capsys.readouterr().err
+        storage.reset()
+
+    def test_nan_property_rejected_upfront(self, tmp_path, monkeypatch,
+                                           capsys):
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.tools.export_import import import_events
+
+        path = tmp_path / "nan.jsonl"
+        path.write_bytes(
+            b'{"event":"e","entityType":"t","entityId":"a"}\n'
+            b'{"event":"e","entityType":"t","entityId":"b",'
+            b'"properties":{"x":NaN}}\n')
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_PIO_TYPE", "sqlite")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_PIO_PATH",
+                           str(tmp_path / "nan.db"))
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "PIO")
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "PIO")
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "PIO")
+        storage.reset()
+        storage.get_metadata_apps().insert(App(0, "na"))
+        rc = import_events(str(path), app_name="na")
+        assert rc == 1
+        assert "nan.jsonl:2" in capsys.readouterr().err
+        # the whole import aborted — nothing inserted
+        assert list(storage.get_levents().find(app_id=1)) == []
+        storage.reset()
+
+    def test_error_line_reported(self, tmp_path, monkeypatch, capsys):
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.tools.export_import import import_events
+
+        objs = list(CORPUS[:2])
+        bad = {"event": "$bogus", "entityType": "user", "entityId": "u"}
+        path = tmp_path / "bad.jsonl"
+        path.write_bytes(_lines(objs + [bad]))
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_PIO_TYPE", "sqlite")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_PIO_PATH",
+                           str(tmp_path / "err.db"))
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "PIO")
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "PIO")
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "PIO")
+        storage.reset()
+        storage.get_metadata_apps().insert(App(0, "errapp"))
+        rc = import_events(str(path), app_name="errapp")
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "bad.jsonl:3" in err
+        assert "not a supported reserved event name" in err
+        # nothing imported
+        assert list(storage.get_levents().find(app_id=1)) == []
+        storage.reset()
