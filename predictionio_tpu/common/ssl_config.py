@@ -1,0 +1,43 @@
+"""TLS context construction for the HTTP daemons.
+
+Parity: ``SSLConfiguration.scala:28-72`` — the reference loads a JKS
+keystore named in ``server.conf`` and builds a TLS context for spray's
+HTTPS binding. Here the PEM cert/key files named in ``server.json``
+build an ``ssl.SSLContext``; any server's listening socket can be wrapped
+with it (``wrap_server``).
+"""
+
+from __future__ import annotations
+
+import ssl
+from typing import Optional
+
+from predictionio_tpu.common.auth import ServerConfig
+
+
+class SSLConfiguration:
+    def __init__(self, config: Optional[ServerConfig] = None):
+        self.config = config or ServerConfig()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.config.ssl_certfile)
+
+    def ssl_context(self) -> ssl.SSLContext:
+        """Server-side TLS context (SSLConfiguration.scala:50-61). Modern
+        defaults (TLS 1.2+) replace the reference's 2015-era cipher list."""
+        if not self.enabled:
+            raise ValueError("ssl.certfile is not configured in server.json")
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        ctx.load_cert_chain(
+            certfile=self.config.ssl_certfile,
+            keyfile=self.config.ssl_keyfile,
+            password=self.config.ssl_password,
+        )
+        return ctx
+
+    def wrap_server(self, httpd) -> None:
+        """Wrap an ``http.server`` instance's listening socket in TLS."""
+        httpd.socket = self.ssl_context().wrap_socket(
+            httpd.socket, server_side=True)

@@ -52,6 +52,10 @@ BACKENDS: Dict[str, Dict[str, str]] = {
         "EvaluationInstances": "predictionio_tpu.data.storage.sqlite:SqliteEvaluationInstances",
         "Models": "predictionio_tpu.data.storage.sqlite:SqliteModels",
     },
+    # MODELDATA-only filesystem blob store (LocalFSModels.scala analog)
+    "localfs": {
+        "Models": "predictionio_tpu.data.storage.localfs:LocalFSModels",
+    },
 }
 
 
@@ -164,7 +168,13 @@ class StorageRegistry:
         key = (source, kind)
         with self._lock:
             if key not in self._cache:
-                spec = BACKENDS[cfg["type"]][kind]
+                kinds = BACKENDS[cfg["type"]]
+                if kind not in kinds:
+                    raise StorageError(
+                        f"Storage source {source} (type {cfg['type']}) does "
+                        f"not support {kind}; it provides {sorted(kinds)}. "
+                        f"Bind repository {repo} to a different source.")
+                spec = kinds[kind]
                 if kind == "PEvents" and spec == BACKENDS[cfg["type"]]["LEvents"]:
                     # Backend has no dedicated PEvents: wrap the SHARED
                     # LEvents DAO so both views see the same state.

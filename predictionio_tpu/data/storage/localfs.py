@@ -1,0 +1,65 @@
+"""Filesystem model blob store.
+
+Parity target: ``data/.../storage/localfs/LocalFSModels.scala`` — model
+blobs as flat files under a configured directory, keyed by engine-instance
+id. This is the MODELDATA-only backend (``PIO_STORAGE_SOURCES_<N>_TYPE=
+localfs``, ``..._PATH=<dir>``); binding METADATA/EVENTDATA to it fails at
+registry level, as with the reference's backend capability matrix.
+
+Blobs land in ``<dir>/pio_model_<id>`` with an atomic rename so a crashed
+writer never leaves a torn model for a concurrent deploy to load.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import tempfile
+from typing import Optional
+
+from predictionio_tpu.data.storage import base
+
+_SAFE = re.compile(r"[^A-Za-z0-9._-]")
+
+
+def _fname(mid: str) -> str:
+    """Sanitized, INJECTIVE id -> filename mapping: the readable prefix
+    cannot escape the directory, and the id-hash suffix keeps distinct
+    ids ('a/b' vs 'a_b') from colliding onto one file."""
+    digest = hashlib.sha256(mid.encode("utf-8")).hexdigest()[:16]
+    return f"pio_model_{_SAFE.sub('_', mid)[:80]}_{digest}"
+
+
+class LocalFSModels(base.Models):
+    def __init__(self, config: Optional[dict] = None):
+        cfg = config or {}
+        self._dir = cfg.get("path") or os.path.join(
+            os.getcwd(), ".pio_store", "models")
+        os.makedirs(self._dir, exist_ok=True)
+
+    def insert(self, m: base.Model) -> None:
+        final = os.path.join(self._dir, _fname(m.id))
+        fd, tmp = tempfile.mkstemp(dir=self._dir, prefix=".tmp_model_")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(m.models)
+            os.replace(tmp, final)  # atomic on POSIX
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def get(self, mid: str) -> Optional[base.Model]:
+        path = os.path.join(self._dir, _fname(mid))
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return base.Model(id=mid, models=f.read())
+
+    def delete(self, mid: str) -> bool:
+        path = os.path.join(self._dir, _fname(mid))
+        if not os.path.exists(path):
+            return False
+        os.unlink(path)
+        return True

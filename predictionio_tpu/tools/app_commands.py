@@ -83,6 +83,24 @@ def app_show(name: str) -> int:
     return 0
 
 
+def delete_app_cascade(app_id: int, reg=None) -> None:
+    """Remove an app and everything attached to it: per-channel event
+    stores, channel rows, the default event store, access keys, and the
+    app row (Console `app delete` semantics; shared by the admin REST
+    server so the two paths cannot diverge)."""
+    reg = reg or storage.registry()
+    channels = reg.get_metadata_channels()
+    levents = reg.get_levents()
+    for c in channels.get_by_appid(app_id):
+        levents.remove(app_id, c.id)
+        channels.delete(c.id)
+    levents.remove(app_id)
+    keys = reg.get_metadata_access_keys()
+    for k in keys.get_by_appid(app_id):
+        keys.delete(k.key)
+    reg.get_metadata_apps().delete(app_id)
+
+
 def app_delete(name: str, force: bool = False) -> int:
     apps = storage.get_metadata_apps()
     app = apps.get_by_name(name)
@@ -93,16 +111,7 @@ def app_delete(name: str, force: bool = False) -> int:
     if not force and not _confirm(f"Delete app {name} and ALL its data?"):
         print("[INFO] Aborted.")
         return 0
-    channels = storage.get_metadata_channels()
-    levents = storage.get_levents()
-    for c in channels.get_by_appid(app.id):
-        levents.remove(app.id, c.id)
-        channels.delete(c.id)
-    levents.remove(app.id)
-    keys = storage.get_metadata_access_keys()
-    for k in keys.get_by_appid(app.id):
-        keys.delete(k.key)
-    apps.delete(app.id)
+    delete_app_cascade(app.id)
     print(f"[INFO] App successfully deleted: {name}")
     return 0
 

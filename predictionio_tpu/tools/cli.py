@@ -126,6 +126,9 @@ def build_parser() -> argparse.ArgumentParser:
     build.set_defaults(func=run_commands.cmd_build)
 
     train = sub.add_parser("train", help="train an engine instance")
+    train.add_argument("--profile-dir", default=None,
+                       help="write a jax.profiler trace here "
+                            "(TensorBoard/Perfetto)")
     _add_engine_args(train)
     train.add_argument("--batch", default="")
     train.add_argument("--skip-sanity-check", action="store_true")
@@ -161,6 +164,18 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--port", type=int, default=7070)
     es.add_argument("--stats", action="store_true")
     es.set_defaults(func=run_commands.cmd_eventserver)
+
+    adm = sub.add_parser("adminserver", help="start the admin REST server")
+    adm.add_argument("--ip", default="localhost")
+    adm.add_argument("--port", type=int, default=7071)
+    adm.set_defaults(func=run_commands.cmd_adminserver)
+
+    dash = sub.add_parser("dashboard", help="start the evaluation dashboard")
+    dash.add_argument("--ip", default="localhost")
+    dash.add_argument("--port", type=int, default=9000)
+    dash.add_argument("--server-config", default=None,
+                      help="server.json with accessKey/ssl settings")
+    dash.set_defaults(func=run_commands.cmd_dashboard)
 
     tpl = sub.add_parser("template", help="engine template scaffolds")
     tpl_sub = tpl.add_subparsers(dest="template_command")

@@ -73,10 +73,13 @@ def cmd_train(args) -> int:
     from predictionio_tpu.core.base import TrainingInterruption
     from predictionio_tpu.workflow.create_workflow import create_workflow
 
+    from predictionio_tpu.utils.tracing import profile_trace
+
     try:
         variant = _load_variant(args.engine_variant)
         config = _workflow_config(args, variant)
-        instance_id = create_workflow(config, variant=variant)
+        with profile_trace(getattr(args, "profile_dir", None)):
+            instance_id = create_workflow(config, variant=variant)
     except TrainingInterruption as e:
         print(f"[INFO] Training interrupted: {e}")
         return 0
@@ -206,4 +209,29 @@ def cmd_eventserver(args) -> int:
         server.serve_forever()
     except KeyboardInterrupt:
         server.stop()
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    """Console adminserver (Console.scala:747-751)."""
+    from predictionio_tpu.tools.admin_server import AdminServer, AdminServerConfig
+
+    server = AdminServer(AdminServerConfig(ip=args.ip, port=args.port))
+    print(f"[INFO] Admin Server is ready at http://{args.ip}:{args.port}.")
+    server.serve_forever()
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    """Console dashboard (Console.scala:753-757)."""
+    from predictionio_tpu.common import ServerConfig
+    from predictionio_tpu.tools.dashboard import Dashboard, DashboardConfig
+
+    server_config = ServerConfig.load(args.server_config) \
+        if args.server_config else ServerConfig.load()
+    server = Dashboard(DashboardConfig(ip=args.ip, port=args.port,
+                                       server_config=server_config))
+    scheme = "https" if server_config.ssl_certfile else "http"
+    print(f"[INFO] Dashboard is ready at {scheme}://{args.ip}:{args.port}.")
+    server.serve_forever()
     return 0

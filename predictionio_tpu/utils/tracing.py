@@ -1,0 +1,120 @@
+"""Tracing and profiling utilities.
+
+The reference has no tracing beyond the query server's request counters
+and Spark's own UI (SURVEY §5); the TPU build upgrades this to real
+observability:
+
+- :class:`LatencyHistogram` — thread-safe log-bucketed latency histogram
+  with percentile estimates, used by the query server for per-query
+  serving times (replacing the reference's single running average,
+  ``CreateServer.scala:438-440,623-630``).
+- :func:`profile_trace` — wraps a block in a ``jax.profiler`` trace
+  (viewable in TensorBoard/Perfetto) when a directory is given; the
+  Spark-UI analog for XLA programs.
+- :func:`span` — debug-log a named wall-clock span.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("pio.tracing")
+
+# bucket upper bounds in seconds (log-ish scale), last bucket = +inf
+_BOUNDS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5,
+           1.0, 2.0, 5.0)
+
+
+class LatencyHistogram:
+    """Thread-safe latency histogram with percentile estimation.
+
+    Percentiles are estimated by linear interpolation inside the matched
+    bucket — good to within a bucket width, which is what a serving
+    dashboard needs.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(_BOUNDS) + 1)
+        self._total = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._last = 0.0
+
+    def record(self, seconds: float) -> None:
+        i = 0
+        while i < len(_BOUNDS) and seconds > _BOUNDS[i]:
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._total += 1
+            self._sum += seconds
+            self._last = seconds
+            if seconds > self._max:
+                self._max = seconds
+
+    def _percentile_locked(self, q: float) -> float:
+        if self._total == 0:
+            return 0.0
+        target = q * self._total
+        acc = 0
+        for i, c in enumerate(self._counts):
+            if acc + c >= target and c > 0:
+                lo = 0.0 if i == 0 else _BOUNDS[i - 1]
+                hi = _BOUNDS[i] if i < len(_BOUNDS) else self._max
+                frac = (target - acc) / c
+                return lo + (max(hi, lo) - lo) * frac
+            acc += c
+        return self._max
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            if self._total == 0:
+                return {"count": 0}
+            return {
+                "count": self._total,
+                "meanSec": self._sum / self._total,
+                "lastSec": self._last,
+                "maxSec": self._max,
+                "p50Sec": self._percentile_locked(0.50),
+                "p90Sec": self._percentile_locked(0.90),
+                "p99Sec": self._percentile_locked(0.99),
+            }
+
+    def buckets(self) -> List[Dict[str, object]]:
+        with self._lock:
+            counts = list(self._counts)
+        out = []
+        for i, c in enumerate(counts):
+            le = _BOUNDS[i] if i < len(_BOUNDS) else float("inf")
+            out.append({"le": le, "count": c})
+        return out
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: Optional[str] = None):
+    """Capture a jax.profiler trace of the block into ``trace_dir``
+    (no-op when None). View with TensorBoard's profile plugin or
+    Perfetto."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+    logger.info("profiler trace written to %s", trace_dir)
+
+
+@contextlib.contextmanager
+def span(name: str, level: int = logging.DEBUG):
+    """Log the wall-clock duration of a block."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.log(level, "%s took %.3fs", name, time.perf_counter() - t0)

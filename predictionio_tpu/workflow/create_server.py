@@ -47,6 +47,7 @@ from predictionio_tpu.core.base import WorkflowParams
 from predictionio_tpu.core.context import ComputeContext, workflow_context
 from predictionio_tpu.data import storage
 from predictionio_tpu.data.storage.base import EngineInstance, StorageError
+from predictionio_tpu.utils.tracing import LatencyHistogram
 from predictionio_tpu.workflow import core_workflow
 from predictionio_tpu.workflow.server_plugins import EngineServerPluginContext
 
@@ -186,10 +187,7 @@ class QueryServer:
         self.ctx = ctx or workflow_context(mode="serving", batch=config.batch)
         self._deployment: Optional[_Deployment] = None
         self._swap_lock = threading.Lock()
-        self._stats_lock = threading.Lock()
-        self.request_count = 0
-        self.last_serving_sec = 0.0
-        self.avg_serving_sec = 0.0
+        self.latency = LatencyHistogram()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -325,13 +323,7 @@ class QueryServer:
             except Exception:
                 logger.exception("output sniffer failed")
 
-        dt = time.perf_counter() - t0
-        with self._stats_lock:
-            self.last_serving_sec = dt
-            self.avg_serving_sec = (
-                (self.avg_serving_sec * self.request_count) + dt
-            ) / (self.request_count + 1)
-            self.request_count += 1
+        self.latency.record(time.perf_counter() - t0)
         return 200, result
 
     def _feedback(self, dep: _Deployment, query_dict: Mapping[str, Any],
@@ -396,12 +388,7 @@ class QueryServer:
 
     def status(self) -> Dict[str, Any]:
         dep = self._deployment
-        with self._stats_lock:
-            counts = {
-                "requestCount": self.request_count,
-                "avgServingSec": self.avg_serving_sec,
-                "lastServingSec": self.last_serving_sec,
-            }
+        summary = self.latency.summary()
         return {
             "status": "alive",
             "engineInstanceId": dep.instance.id if dep else None,
@@ -410,7 +397,12 @@ class QueryServer:
             "algorithms": [type(a).__name__ for a in dep.algorithms]
             if dep else [],
             "feedback": self.config.feedback,
-            **counts,
+            # reference status fields (CreateServer.scala:438-440) derived
+            # from the histogram, which owns all latency bookkeeping
+            "requestCount": summary.get("count", 0),
+            "avgServingSec": summary.get("meanSec", 0.0),
+            "lastServingSec": summary.get("lastSec", 0.0),
+            "servingLatency": summary,
         }
 
     # -- HTTP lifecycle ----------------------------------------------------

@@ -1,0 +1,208 @@
+"""Admin server (:7071 analog), dashboard (:9000 analog), and the common
+auth/SSL layer — HTTP-level tests on ephemeral ports."""
+
+import datetime as dt
+import json
+import subprocess
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.common import KeyAuthentication, ServerConfig, SSLConfiguration
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.storage.base import EvaluationInstance
+from predictionio_tpu.tools.admin_server import AdminServer, AdminServerConfig
+from predictionio_tpu.tools.dashboard import Dashboard, DashboardConfig
+
+UTC = dt.timezone.utc
+
+
+def _req(url, method="GET", body=None):
+    req = urllib.request.Request(url, method=method,
+                                 data=body.encode() if body else None)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            payload = r.read().decode()
+            if "json" in (r.headers.get("Content-Type") or ""):
+                payload = json.loads(payload or "null")
+            return r.status, payload
+    except urllib.error.HTTPError as e:
+        payload = e.read().decode()
+        try:
+            payload = json.loads(payload)
+        except json.JSONDecodeError:
+            pass
+        return e.code, payload
+
+
+@pytest.fixture
+def admin(mem_storage):
+    server = AdminServer(AdminServerConfig(ip="127.0.0.1", port=0)).start()
+    yield f"http://127.0.0.1:{server.port}", server
+    server.stop()
+
+
+class TestAdminServer:
+    def test_alive(self, admin):
+        url, _ = admin
+        status, payload = _req(url + "/")
+        assert status == 200 and payload == {"status": "alive"}
+
+    def test_app_lifecycle(self, admin):
+        url, _ = admin
+        # create
+        status, payload = _req(url + "/cmd/app", "POST",
+                               json.dumps({"name": "adminapp"}))
+        assert status == 200 and payload["status"] == 1
+        assert payload["name"] == "adminapp" and len(payload["key"]) == 64
+        # duplicate -> status 0 (CommandClient.futureAppNew)
+        _, dup = _req(url + "/cmd/app", "POST",
+                      json.dumps({"name": "adminapp"}))
+        assert dup["status"] == 0 and "already exists" in dup["message"]
+        # list
+        _, listing = _req(url + "/cmd/app")
+        assert listing["status"] == 1
+        assert [a["name"] for a in listing["apps"]] == ["adminapp"]
+        assert len(listing["apps"][0]["keys"]) == 1
+        # data-delete then delete
+        _, dd = _req(url + "/cmd/app/adminapp/data", "DELETE")
+        assert dd["status"] == 1
+        _, d = _req(url + "/cmd/app/adminapp", "DELETE")
+        assert d["status"] == 1
+        _, listing2 = _req(url + "/cmd/app")
+        assert listing2["apps"] == []
+        # deleting again -> status 0
+        _, d2 = _req(url + "/cmd/app/adminapp", "DELETE")
+        assert d2["status"] == 0 and "does not exist" in d2["message"]
+
+    def test_app_delete_cleans_channels(self, admin):
+        from predictionio_tpu.data.storage.base import Channel
+
+        url, _ = admin
+        _, created = _req(url + "/cmd/app", "POST",
+                          json.dumps({"name": "chanapp"}))
+        appid = created["id"]
+        cid = storage.get_metadata_channels().insert(
+            Channel(0, "ch1", appid))
+        assert cid is not None
+        _, d = _req(url + "/cmd/app/chanapp", "DELETE")
+        assert d["status"] == 1
+        # channel rows must not be orphaned (CLI app delete parity)
+        assert storage.get_metadata_channels().get_by_appid(appid) == []
+
+    def test_bad_request(self, admin):
+        url, _ = admin
+        status, _ = _req(url + "/cmd/app", "POST", "{nope")
+        assert status == 400
+        status, _ = _req(url + "/cmd/nosuch")
+        assert status == 404
+
+
+class TestDashboard:
+    @pytest.fixture
+    def dash(self, mem_storage):
+        ei = EvaluationInstance(
+            id="ev1", status="EVALCOMPLETED",
+            start_time=dt.datetime(2021, 1, 1, tzinfo=UTC),
+            end_time=dt.datetime(2021, 1, 2, tzinfo=UTC),
+            evaluation_class="my.Eval", batch="b1",
+            evaluator_results="one-liner",
+            evaluator_results_html="<b>html</b>",
+            evaluator_results_json='{"metric": 1.5}')
+        storage.get_metadata_evaluation_instances().insert(ei)
+        server = Dashboard(
+            DashboardConfig(ip="127.0.0.1", port=0)).start()
+        yield f"http://127.0.0.1:{server.port}", server
+        server.stop()
+
+    def test_index_lists_completed(self, dash):
+        url, _ = dash
+        status, body = _req(url + "/")
+        assert status == 200
+        assert "ev1" in body and "my.Eval" in body
+
+    def test_results_endpoints(self, dash):
+        url, _ = dash
+        assert _req(url + "/engine_instances/ev1/evaluator_results.txt") \
+            == (200, "one-liner")
+        assert _req(url + "/engine_instances/ev1/evaluator_results.html") \
+            == (200, "<b>html</b>")
+        status, payload = _req(
+            url + "/engine_instances/ev1/evaluator_results.json")
+        assert status == 200 and payload == {"metric": 1.5}
+        status, _ = _req(
+            url + "/engine_instances/nope/evaluator_results.json")
+        assert status == 404
+
+    def test_cors_local_results(self, dash):
+        url, _ = dash
+        req = urllib.request.Request(
+            url + "/engine_instances/ev1/local_evaluator_results.json")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.headers["Access-Control-Allow-Origin"] == "*"
+
+    def test_auth_rejects_bad_key(self, mem_storage):
+        cfg = ServerConfig(access_key="sekret")
+        server = Dashboard(DashboardConfig(ip="127.0.0.1", port=0,
+                                           server_config=cfg)).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            status, _ = _req(url + "/")
+            assert status == 401
+            status, _ = _req(url + "/?accessKey=wrong")
+            assert status == 401
+            status, body = _req(url + "/?accessKey=sekret")
+            assert status == 200 and "Dashboard" in body
+            # results routes are gated too (the sensitive payload)
+            status, _ = _req(
+                url + "/engine_instances/x/evaluator_results.json")
+            assert status == 401
+            status, _ = _req(
+                url + "/engine_instances/x/local_evaluator_results.json")
+            assert status == 401
+        finally:
+            server.stop()
+
+
+class TestKeyAuthentication:
+    def test_disabled_when_no_key(self):
+        assert KeyAuthentication(ServerConfig()).authenticate({})
+
+    def test_key_check(self):
+        auth = KeyAuthentication(ServerConfig(access_key="k1"))
+        assert not auth.authenticate({})
+        assert not auth.authenticate({"accessKey": ["nope"]})
+        assert auth.authenticate({"accessKey": ["k1"]})
+
+    def test_load_config(self, tmp_path):
+        p = tmp_path / "server.json"
+        p.write_text(json.dumps({
+            "accessKey": "abc",
+            "ssl": {"certfile": "c.pem", "keyfile": "k.pem"}}))
+        cfg = ServerConfig.load(str(p))
+        assert cfg.access_key == "abc"
+        assert cfg.ssl_certfile == "c.pem"
+        assert ServerConfig.load(str(tmp_path / "absent.json")) \
+            == ServerConfig()
+
+
+class TestSSLConfiguration:
+    def test_context_from_selfsigned(self, tmp_path):
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        proc = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost"],
+            capture_output=True)
+        if proc.returncode != 0:
+            pytest.skip("openssl unavailable")
+        cfg = ServerConfig(ssl_certfile=str(cert), ssl_keyfile=str(key))
+        ctx = SSLConfiguration(cfg).ssl_context()
+        import ssl as _ssl
+        assert ctx.minimum_version >= _ssl.TLSVersion.TLSv1_2
+
+    def test_disabled_raises(self):
+        with pytest.raises(ValueError):
+            SSLConfiguration(ServerConfig()).ssl_context()

@@ -239,6 +239,57 @@ class TestMetadata:
         assert m.get("m1") is None
 
 
+class TestLocalFSModels:
+    """MODELDATA-only filesystem backend (LocalFSModels.scala analog)."""
+
+    def _store(self, tmp_path):
+        from predictionio_tpu.data.storage.localfs import LocalFSModels
+        return LocalFSModels({"path": str(tmp_path / "models")})
+
+    def test_roundtrip_and_overwrite(self, tmp_path):
+        m = self._store(tmp_path)
+        m.insert(Model("m1", b"v1"))
+        m.insert(Model("m1", b"v2"))  # keyed upsert like the DB backends
+        assert m.get("m1").models == b"v2"
+        assert m.delete("m1")
+        assert not m.delete("m1")
+        assert m.get("m1") is None
+
+    def test_id_sanitization(self, tmp_path):
+        m = self._store(tmp_path)
+        m.insert(Model("../../evil", b"x"))
+        # blob stays inside the store directory
+        import os
+        assert not os.path.exists(tmp_path / "evil")
+        assert m.get("../../evil").models == b"x"
+
+    def test_registry_binding(self, tmp_path, monkeypatch):
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import StorageError
+
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_DB_TYPE", "memory")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_FS_TYPE", "localfs")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_FS_PATH",
+                           str(tmp_path / "fsmodels"))
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "DB")
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "DB")
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "FS")
+        storage.reset()
+        try:
+            models = storage.get_model_data_models()
+            models.insert(Model("mm", b"blob"))
+            assert list((tmp_path / "fsmodels").glob("pio_model_mm_*"))
+            assert models.get("mm").models == b"blob"
+            # binding EVENTDATA to the fs source must fail loudly
+            monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE",
+                               "FS")
+            storage.reset()
+            with pytest.raises(StorageError, match="does not support"):
+                storage.get_levents()
+        finally:
+            storage.reset()
+
+
 class TestSqliteConcurrency:
     """ADVICE r1: ':memory:' must be one shared database across threads."""
 

@@ -1,0 +1,72 @@
+"""Tracing utilities: latency histogram quantiles + profiler wrapper."""
+
+import pytest
+
+from predictionio_tpu.utils.tracing import LatencyHistogram, profile_trace, span
+
+
+class TestLatencyHistogram:
+    def test_empty(self):
+        assert LatencyHistogram().summary() == {"count": 0}
+
+    def test_quantiles(self):
+        h = LatencyHistogram()
+        for ms in range(1, 101):  # 1..100ms uniform
+            h.record(ms / 1000.0)
+        s = h.summary()
+        assert s["count"] == 100
+        assert s["meanSec"] == pytest.approx(0.0505, rel=0.01)
+        assert s["maxSec"] == pytest.approx(0.1)
+        # bucketed estimates: right bucket, not exact order statistics
+        assert 0.02 <= s["p50Sec"] <= 0.1
+        assert s["p90Sec"] >= s["p50Sec"]
+        assert s["p99Sec"] >= s["p90Sec"]
+
+    def test_concurrent_records(self):
+        import threading
+
+        h = LatencyHistogram()
+
+        def work():
+            for _ in range(1000):
+                h.record(0.003)
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert h.summary()["count"] == 8000
+
+    def test_buckets_cover_all(self):
+        h = LatencyHistogram()
+        h.record(1e-6)
+        h.record(100.0)  # beyond last bound -> +inf bucket
+        b = h.buckets()
+        assert b[0]["count"] == 1
+        assert b[-1]["le"] == float("inf") and b[-1]["count"] == 1
+
+
+class TestSpans:
+    def test_span_logs(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.DEBUG, logger="pio.tracing"):
+            with span("unit-test-span"):
+                pass
+        assert any("unit-test-span" in r.message for r in caplog.records)
+
+    def test_profile_trace_noop(self):
+        with profile_trace(None):
+            x = 1
+        assert x == 1
+
+    def test_profile_trace_writes(self, tmp_path):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        with profile_trace(str(tmp_path / "trace")):
+            jnp.ones(8).sum().block_until_ready()
+        # the profiler lays out <dir>/plugins/profile/<run>/...
+        produced = list((tmp_path / "trace").rglob("*"))
+        assert produced, "no trace files written"
