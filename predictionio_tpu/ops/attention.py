@@ -1,0 +1,166 @@
+"""Attention ops: reference MHA + ring attention for sequence parallelism.
+
+The reference framework has no sequence models at all (SURVEY §2.6: SP/CP
+row = "No"), so this module is net-new capability the TPU build is required
+to carry: long-context attention that scales past one device's HBM by
+sharding the SEQUENCE dimension over the mesh and rotating K/V blocks
+around the ring with ``jax.lax.ppermute`` (Liu et al., "Ring Attention
+with Blockwise Transformers"; see PAPERS.md).
+
+Design notes (TPU-first):
+- The per-step block computation is two einsums + online-softmax updates —
+  all MXU/VPU work with static shapes; the ring rotation is a ``ppermute``
+  that XLA overlaps with compute over ICI.
+- Online softmax keeps running (max, denominator, numerator) so no
+  [L, L_global] score matrix ever materializes: memory is O(L_local²
+  per-step block), which is what makes million-token contexts feasible.
+- Causal masking uses global positions derived from the device's ring
+  index, so the sharded result is bit-for-bit the same computation as the
+  dense reference (up to float reduction order).
+
+Layout convention: ``[batch, heads, seq, head_dim]``; the sequence axis is
+the sharded one in the ring variant.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+
+def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Dense multi-head attention oracle: softmax(QKᵀ·scale [+mask]) V.
+
+    ``q/k/v: [B, H, L, D]``. Used as the numerical reference for the ring
+    variant and fine on its own for short sequences.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   precision=jax.lax.Precision.HIGHEST) * scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        qpos = jnp.arange(lq)[:, None]
+        kpos = jnp.arange(lk)[None, :]
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
+                          causal: bool, scale: float):
+    """Per-device ring attention body (runs under shard_map).
+
+    ``q/k/v: [B, H, L_local, D]`` — this device's sequence shard. Each of
+    the ``axis_size`` steps attends Q against the currently-held K/V block,
+    folds the result into online-softmax accumulators, then rotates K/V to
+    the next device on the ring.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, H, L, D = q.shape
+    my_idx = jax.lax.axis_index(axis_name)
+    hi = jax.lax.Precision.HIGHEST
+
+    # accumulators: numerator [B,H,L,D], denominator + running max [B,H,L].
+    # Mark the (device-constant) initializers as varying over the ring
+    # axis so the fori_loop carry type matches its per-device outputs.
+    if hasattr(jax.lax, "pcast"):
+        _vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    else:  # older jax
+        _vary = lambda x: jax.lax.pvary(x, (axis_name,))
+    o0 = _vary(jnp.zeros((B, H, L, D), dtype=jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, L), dtype=jnp.float32))
+    m0 = _vary(jnp.full((B, H, L), -jnp.inf, dtype=jnp.float32))
+
+    qpos = my_idx * L + jnp.arange(L)  # global query positions
+
+    def fold(i, o, l, m, k_blk, v_blk):
+        """Fold the currently-held K/V block into the accumulators.
+        The block held at step i originated on device (my_idx - i) % n."""
+        src = (my_idx - i) % axis_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k_blk.astype(jnp.float32), precision=hi) * scale
+        if causal:
+            kpos = src * L + jnp.arange(L)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        corr = jnp.where(jnp.isneginf(m_new), 0.0, jnp.exp(m - m_new))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(jnp.isneginf(m_new[..., None]), 0.0, p)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32), precision=hi)
+        return o_new, l_new, m_new
+
+    # fori_loop: one compiled step regardless of ring size. Runs n-1
+    # fold+rotate steps; the LAST fold is peeled outside the loop so no
+    # dead final rotation ships K/V over ICI just to be discarded.
+    def body(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        o, l, m = fold(i, o, l, m, k_blk, v_blk)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, l, m, k_blk, v_blk
+
+    o, l, m, k_last, v_last = jax.lax.fori_loop(
+        0, axis_size - 1, body, (o0, l0, m0, k, v))
+    o, l, m = fold(axis_size - 1, o, l, m, k_last, v_last)
+    # rows with no visible keys (can't happen causally: self-block always
+    # visible) keep denominator 0 -> output 0
+    denom = jnp.where(l == 0.0, 1.0, l)
+    return (o / denom[..., None]).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_fn(mesh, axis_name: str, causal: bool, scale: float):
+    """Cached jitted shard_map program per (mesh, axis, causal, scale) —
+    repeated calls (e.g. one per layer per step) hit the jit cache
+    instead of retracing (same pattern as parallel/als_sharding.py)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    n = mesh.shape[axis_name]
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          axis_size=n, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(P(None, None, axis_name, None),) * 3,
+        out_specs=P(None, None, axis_name, None),
+    )
+    return jax.jit(fn)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "data",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Sequence-parallel attention over ``mesh[axis_name]``.
+
+    ``q/k/v: [B, H, L, D]`` global arrays whose ``L`` must divide evenly
+    by the mesh axis size; each device computes its sequence shard while
+    K/V blocks rotate around the ring (ICI ppermute). Returns the global
+    ``[B, H, L, D]`` result matching :func:`mha_reference`.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n:
+        raise ValueError(
+            f"sequence length {q.shape[2]} not divisible by mesh axis "
+            f"{axis_name} of size {n}")
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+
+    spec = NamedSharding(mesh, P(None, None, axis_name, None))
+    q, k, v = (jax.device_put(x, spec) for x in (q, k, v))
+    return _ring_fn(mesh, axis_name, causal, float(scale))(q, k, v)

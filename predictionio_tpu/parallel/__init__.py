@@ -7,7 +7,9 @@ shardings; XLA inserts the all-gathers/psums over ICI. No NCCL/MPI analog
 is needed — collectives are compiled into the program.
 """
 
-from predictionio_tpu.parallel.mesh import data_parallel_mesh
+from predictionio_tpu.parallel.mesh import data_parallel_mesh, mesh_2d
 from predictionio_tpu.parallel.als_sharding import train_als_sharded
+from predictionio_tpu.ops.attention import ring_attention  # sequence parallel
 
-__all__ = ["data_parallel_mesh", "train_als_sharded"]
+__all__ = ["data_parallel_mesh", "mesh_2d", "train_als_sharded",
+           "ring_attention"]
