@@ -133,6 +133,26 @@ def transpose_ratings(pr: PaddedRatings, rows: np.ndarray, cols: np.ndarray,
 # Device kernels
 # ---------------------------------------------------------------------------
 
+def implicit_weights(w, alpha: float):
+    """Hu-Koren-Volinsky confidence/preference weights shared by the XLA
+    and pallas solve paths: A-matrix weights ``alpha*|r|`` and b-vector
+    weights ``pref*(1+alpha*|r|)`` with ``pref = 1 iff r > 0``."""
+    import jax.numpy as jnp
+
+    aw = alpha * jnp.abs(w)
+    bw = (w > 0).astype(w.dtype) * (1.0 + aw)
+    return aw, bw
+
+
+def zero_empty_rows(X, mask):
+    """Rows with no ratings keep a zero factor (matches MLlib dropping
+    them); shared by both solve paths."""
+    import jax.numpy as jnp
+
+    has_any = (jnp.sum(mask, axis=1) > 0).astype(X.dtype)
+    return X * has_any[:, None]
+
+
 def _solve_side(Y, cols, weights, mask, lam: float, alpha: float,
                 implicit: bool):
     """One alternating half-step: given fixed factors ``Y [M, R]`` and this
@@ -160,15 +180,13 @@ def _solve_side(Y, cols, weights, mask, lam: float, alpha: float,
         # ratings carry negative signal (e.g. dislikes).
         # A_b = YtY + alpha * sum_j |r_j| y_j y_j^T + lam I
         # b_b = sum_j p_j (1 + alpha |r_j|) y_j
-        aw = alpha * jnp.abs(w)
-        pref = (w > 0).astype(Y.dtype)
+        aw, bw = implicit_weights(w, alpha)
         gram = jnp.matmul(Y.T, Y, precision=hi)                  # [R, R]
         corr = jnp.einsum("bl,blr,bls->brs", aw, Yg, Yg,
                           precision=hi)                          # [B, R, R]
         A = gram[None, :, :] + corr
         A += lam * jnp.eye(R, dtype=Y.dtype)[None, :, :]
-        b = jnp.einsum("bl,blr->br", pref * (1.0 + aw), Yg,
-                       precision=hi)                             # [B, R]
+        b = jnp.einsum("bl,blr->br", bw, Yg, precision=hi)       # [B, R]
     else:
         # explicit ALS-WR: A_b = sum_j y_j y_j^T + lam n_b I; b = sum r y
         A = jnp.einsum("bl,blr,bls->brs", mask, Yg, Yg, precision=hi)
@@ -179,9 +197,7 @@ def _solve_side(Y, cols, weights, mask, lam: float, alpha: float,
 
     chol = jax.scipy.linalg.cho_factor(A)
     X = jax.scipy.linalg.cho_solve(chol, b)
-    # rows with no ratings keep a zero factor (matches MLlib dropping them)
-    has_any = (jnp.sum(mask, axis=1) > 0).astype(Y.dtype)
-    return X * has_any[:, None]
+    return zero_empty_rows(X, mask)
 
 
 def _als_iterations_impl(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m, *, lam,
