@@ -231,6 +231,39 @@ class ECommAlgorithm(P2LAlgorithm):
             return set()
         return set(events[0].properties.get_opt("items", list) or ())
 
+    def _item_weights(self, model: ECommModel) -> Optional[np.ndarray]:
+        """weighted-items variant: latest $set on constraint/weightedItems
+        carries ``weights: [{"items": [...], "weight": w}, ...]``; scores
+        are multiplied by the item's group weight, default 1.0
+        (weighted-items ALSAlgorithm.scala:217-242,277-278)."""
+        p: ECommAlgorithmParams = self.params
+        try:
+            events = list(LEventStore.find_by_entity(
+                app_name=p.app_name, entity_type="constraint",
+                entity_id="weightedItems", event_names=["$set"],
+                latest=True, limit=1))
+        except Exception as e:
+            logger.error("Error when reading set weightedItems event: %s", e)
+            return None
+        if not events:
+            return None
+        groups = events[0].properties.get_opt("weights", list) or ()
+        if not groups:
+            return None
+        weights = np.ones(len(model.item_map), dtype=np.float64)
+        for group in groups:
+            # live client data: degrade gracefully on ANY malformed group
+            # rather than taking down query serving
+            try:
+                w = float(group["weight"])
+                for item in group["items"]:
+                    ix = model.item_map.get(item)
+                    if ix is not None:
+                        weights[ix] = w
+            except (TypeError, KeyError, ValueError):
+                logger.error("Malformed weights group: %r", group)
+        return weights
+
     def _recent_item_features(self, query: Query,
                               model: ECommModel) -> Optional[np.ndarray]:
         """Latest similar_events of the user -> their item factors
@@ -266,6 +299,10 @@ class ECommAlgorithm(P2LAlgorithm):
                             "user %s.", query.user)
                 return PredictedResult(())
             scores = cosine_scores(recent, model.product_features)
+
+        weights = self._item_weights(model)
+        if weights is not None:
+            scores = scores * weights  # adjustedScore (scala :277-278)
 
         mask = np.ones(len(scores), dtype=bool)
         if query.categories:

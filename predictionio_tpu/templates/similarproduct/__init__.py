@@ -7,10 +7,14 @@ from predictionio_tpu.templates.similarproduct.engine import (  # noqa: F401
     EventDataSource,
     Item,
     ItemScore,
+    LikeAlgorithm,
+    LikeEvent,
+    MultiServing,
     PredictedResult,
     Query,
     SimilarProductModel,
     TrainingData,
     ViewEvent,
     engine_factory,
+    engine_factory_multi,
 )

@@ -25,6 +25,7 @@ import numpy as np
 from predictionio_tpu.controller import (
     Engine,
     LFirstServing,
+    LServing,
     P2LAlgorithm,
     Params,
     PDataSource,
@@ -40,6 +41,9 @@ from predictionio_tpu.ops.als import ALSParams, cosine_scores, pad_ratings, trai
 class DataSourceParams(Params):
     app_name: str
     channel_name: Optional[str] = None
+    # multi variant: also scan like/dislike events (an extra event-store
+    # pass the base ALS engine never needs)
+    read_like_events: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,11 +57,21 @@ class ViewEvent:
     item: str
 
 
+@dataclasses.dataclass(frozen=True)
+class LikeEvent:
+    """like/dislike with time (multi variant, LikeAlgorithm.scala)."""
+    user: str
+    item: str
+    like: bool
+    t: float  # epoch seconds; latest event wins per (user, item)
+
+
 @dataclasses.dataclass
 class TrainingData:
     users: Dict[str, None]
     items: Dict[str, Item]
     view_events: List[ViewEvent]
+    like_events: List[LikeEvent] = dataclasses.field(default_factory=list)
 
     def sanity_check(self) -> None:
         assert self.view_events, (
@@ -113,7 +127,18 @@ class EventDataSource(PDataSource):
                 entity_type="user", event_names=["view"],
                 target_entity_type="item")
         ]
-        return TrainingData(users, items, views)
+        likes: List[LikeEvent] = []
+        if p.read_like_events:
+            likes = [
+                LikeEvent(user=e.entity_id, item=e.target_entity_id,
+                          like=(e.event == "like"),
+                          t=e.event_time.timestamp())
+                for e in PEventStore.find(
+                    app_name=p.app_name, channel_name=p.channel_name,
+                    entity_type="user", event_names=["like", "dislike"],
+                    target_entity_type="item")
+            ]
+        return TrainingData(users, items, views, likes)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -136,6 +161,32 @@ class SimilarProductModel:
         assert np.isfinite(self.product_features).all()
 
 
+def _train_item_model(ratings: Dict[Tuple[int, int], float],
+                      user_map: StringIndexBiMap,
+                      item_map: StringIndexBiMap,
+                      item_meta: Dict[str, Item],
+                      p: "ALSAlgorithmParams") -> SimilarProductModel:
+    """Shared (user,item)->rating dict -> implicit ALS -> item-factor
+    model tail used by ALSAlgorithm and LikeAlgorithm."""
+    if not ratings:
+        raise ValueError(
+            "ratings cannot be empty. Please check if your events "
+            "contain valid user and item ID.")
+    keys = np.asarray(list(ratings), dtype=np.int64)
+    vals = np.asarray(list(ratings.values()), dtype=np.float32)
+    rows, cols = keys[:, 0], keys[:, 1]
+    n_u, n_i = len(user_map), len(item_map)
+    params = ALSParams(rank=p.rank, num_iterations=p.num_iterations,
+                       lambda_=p.lambda_,
+                       seed=0 if p.seed is None else p.seed)
+    _, item_factors = train_als(
+        pad_ratings(rows, cols, vals, n_u, n_i),
+        pad_ratings(cols, rows, vals, n_i, n_u),
+        params)
+    items = {item_map[iid]: item for iid, item in item_meta.items()}
+    return SimilarProductModel(item_factors, item_map, items)
+
+
 class ALSAlgorithm(P2LAlgorithm):
     """Implicit ALS on view counts; keeps productFeatures
     (ALSAlgorithm.scala:36-87)."""
@@ -155,23 +206,7 @@ class ALSAlgorithm(P2LAlgorithm):
             if u is None or i is None:
                 continue  # view of an entity without a $set (scala :59-66)
             counts[(u, i)] = counts.get((u, i), 0.0) + 1.0
-        if not counts:
-            raise ValueError(
-                "ratings cannot be empty. Please check if your events "
-                "contain valid user and item ID.")
-        keys = np.asarray(list(counts), dtype=np.int64)
-        vals = np.asarray(list(counts.values()), dtype=np.float32)
-        rows, cols = keys[:, 0], keys[:, 1]
-        n_u, n_i = len(user_map), len(item_map)
-        params = ALSParams(rank=p.rank, num_iterations=p.num_iterations,
-                           lambda_=p.lambda_,
-                           seed=0 if p.seed is None else p.seed)
-        _, item_factors = train_als(
-            pad_ratings(rows, cols, vals, n_u, n_i),
-            pad_ratings(cols, rows, vals, n_i, n_u),
-            params)
-        items = {item_map[iid]: item for iid, item in pd.items.items()}
-        return SimilarProductModel(item_factors, item_map, items)
+        return _train_item_model(counts, user_map, item_map, pd.items, p)
 
     def predict(self, model: SimilarProductModel,
                 query: Query) -> PredictedResult:
@@ -215,6 +250,65 @@ class ALSAlgorithm(P2LAlgorithm):
             for i, ix in zip(items, top)))
 
 
+class LikeAlgorithm(ALSAlgorithm):
+    """multi variant: ALS on like/dislike events — an user may flip
+    opinion, so the LATEST event per (user, item) wins; like -> +1,
+    dislike -> -1, trained with implicit confidence (negative value =
+    negative signal). Mirrors ``multi/.../LikeAlgorithm.scala:21-102``."""
+
+    def train(self, ctx: ComputeContext,
+              pd: TrainingData) -> SimilarProductModel:
+        p: ALSAlgorithmParams = self.params
+        if not pd.like_events:
+            raise ValueError(
+                "likeEvents in PreparedData cannot be empty. Please check "
+                "if DataSource generates TrainingData correctly.")
+        user_map = BiMap.string_int(pd.users)
+        item_map = BiMap.string_int(pd.items)
+        latest: Dict[Tuple[int, int], Tuple[bool, float]] = {}
+        for ev in pd.like_events:
+            u, i = user_map.get(ev.user), item_map.get(ev.item)
+            if u is None or i is None:
+                continue
+            prev = latest.get((u, i))
+            if prev is None or ev.t > prev[1]:
+                latest[(u, i)] = (ev.like, ev.t)
+        ratings = {k: (1.0 if like else -1.0)
+                   for k, (like, _) in latest.items()}
+        return _train_item_model(ratings, user_map, item_map, pd.items, p)
+
+
+class MultiServing(LServing):
+    """multi variant Serving: z-score standardize each algorithm's scores
+    (skipped for num==1), then sum per item and take top num
+    (``multi/.../Serving.scala:16-52``)."""
+
+    def serve(self, query: Query,
+              predictions: List[PredictedResult]) -> PredictedResult:
+        if query.num == 1:
+            standardized = [pr.item_scores for pr in predictions]
+        else:
+            standardized = []
+            for pr in predictions:
+                scores = np.asarray([s.score for s in pr.item_scores],
+                                    dtype=np.float64)
+                if len(scores) and scores.std() > 0:
+                    z = (scores - scores.mean()) / scores.std()
+                else:
+                    z = np.zeros_like(scores)
+                standardized.append(tuple(
+                    ItemScore(s.item, float(zs))
+                    for s, zs in zip(pr.item_scores, z)))
+        combined: Dict[str, float] = {}
+        for group in standardized:
+            for s in group:
+                combined[s.item] = combined.get(s.item, 0.0) + s.score
+        ranked = sorted(combined.items(), key=lambda kv: -kv[1])
+        return PredictedResult(tuple(
+            ItemScore(item=k, score=v)
+            for k, v in ranked[:query.num]))
+
+
 def engine_factory() -> Engine:
     """SimilarProductEngine (similarproduct Engine.scala)."""
     return Engine(
@@ -222,4 +316,15 @@ def engine_factory() -> Engine:
         PIdentityPreparator,
         {"als": ALSAlgorithm, "": ALSAlgorithm},
         LFirstServing,
+    )
+
+
+def engine_factory_multi() -> Engine:
+    """multi variant: ALS + LikeAlgorithm ensemble combined by z-score
+    serving (``multi/.../Engine.scala:29-33``)."""
+    return Engine(
+        EventDataSource,
+        PIdentityPreparator,
+        {"als": ALSAlgorithm, "likealgo": LikeAlgorithm},
+        MultiServing,
     )

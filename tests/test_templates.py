@@ -191,6 +191,98 @@ class TestSimilarProductTemplate:
         # unknown query item -> empty
         assert algo.predict(model, Query(items=("zzz",))).item_scores == ()
 
+    def test_multi_variant_like_ensemble(self, app):
+        """multi variant: ALS + LikeAlgorithm combined by z-score serving
+        (multi/.../Engine.scala:29-33, Serving.scala:16-52)."""
+        from predictionio_tpu.templates.similarproduct import (
+            ALSAlgorithmParams, DataSourceParams, Query,
+            engine_factory_multi)
+
+        le = storage.get_levents()
+        # likes within group A; a dislike that should push i3 down
+        likes = []
+        for u in range(6):
+            for i in range(3):
+                likes.append(ev("like", "user", f"u{u}", "item", f"i{i}"))
+            likes.append(ev("dislike", "user", f"u{u}", "item", "i3",
+                            t=T0 + dt.timedelta(seconds=1)))
+        le.insert_batch(likes, app)
+
+        engine = engine_factory_multi()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(
+                app_name="simapp", read_like_events=True)),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=8, num_iterations=5, seed=0)),
+                ("likealgo", ALSAlgorithmParams(rank=8, num_iterations=5,
+                                                seed=0)),
+            ],
+        )
+        models = engine.train(CTX, params)
+        assert len(models) == 2
+        algos = engine._algorithms(params)
+        sv_name, sv_params = params.serving_params
+        serving = engine._make(engine.serving_class_map, sv_name, sv_params,
+                               "serving")
+        query = Query(items=("i0",), num=4)
+        preds = [a.predict(m, query) for a, m in zip(algos, models)]
+        combined = serving.serve(query, preds)
+        assert combined.item_scores
+        assert "i0" not in {s.item for s in combined.item_scores}
+        # combined scores are z-score sums, so items surfaced by both
+        # algorithms rank first; ensure results come from the ensemble
+        items = {s.item for s in combined.item_scores}
+        assert items <= {f"i{i}" for i in range(8)}
+
+    def test_like_flip_uses_latest(self, app):
+        """An user may like then dislike; the LATEST event wins
+        (LikeAlgorithm.scala:63-71)."""
+        from predictionio_tpu.templates.similarproduct import (
+            ALSAlgorithmParams, LikeAlgorithm, EventDataSource,
+            DataSourceParams)
+
+        le = storage.get_levents()
+        evs = []
+        for u in range(6):
+            for i in range(4):
+                evs.append(ev("like", "user", f"u{u}", "item", f"i{i}"))
+        # u0 flips on i0 later
+        evs.append(ev("dislike", "user", "u0", "item", "i0",
+                      t=T0 + dt.timedelta(hours=1)))
+        le.insert_batch(evs, app)
+        ds = EventDataSource(DataSourceParams(app_name="simapp",
+                                              read_like_events=True))
+        td = ds.read_training_base(CTX)
+        algo = LikeAlgorithm(ALSAlgorithmParams(rank=4, num_iterations=3,
+                                                seed=0))
+        model = algo.train(CTX, td)
+        assert np.isfinite(model.product_features).all()
+
+    def test_fake_run(self, mem_storage):
+        """FakeRun executes an arbitrary ctx function through the eval
+        workflow (FakeWorkflow.scala:84-106)."""
+        import datetime as _dt
+
+        from predictionio_tpu.data.storage.base import EvaluationInstance
+        from predictionio_tpu.workflow.core_workflow import run_evaluation
+        from predictionio_tpu.workflow.fake import FakeRun
+
+        ran = []
+        fake = FakeRun(lambda ctx: ran.append(ctx))
+        now = _dt.datetime.now(tz=UTC)
+        run_evaluation(
+            fake.engine, fake.engine_params_list,
+            EvaluationInstance(id="", status="INIT", start_time=now,
+                               end_time=now),
+            fake.evaluator, fake)
+        assert len(ran) == 1
+        # no_save: no best.json artifact, no persisted EVALCOMPLETED row
+        import os
+        assert not os.path.exists("best.json")
+        completed = storage.get_metadata_evaluation_instances() \
+            .get_completed()
+        assert completed == []
+
     def test_view_of_unknown_entity_skipped(self, mem_storage):
         from predictionio_tpu.templates.similarproduct import (
             EventDataSource, DataSourceParams)
@@ -273,6 +365,35 @@ class TestECommerceTemplate:
                props={"items": sorted(top_before)}), aid)
         r2 = algo.predict(model, Query(user="u1", num=8))
         assert not ({s.item for s in r2.item_scores} & top_before)
+
+    def test_weighted_items(self, app):
+        """weighted-items variant: group weights multiply scores
+        (weighted-items ALSAlgorithm.scala:217-278)."""
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        engine, params = self.make_engine_and_params()
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        base = algo.predict(model, Query(user="u0", num=8))
+        assert base.item_scores
+        # weight multiplies the score, so boost a positive-score item
+        # that is NOT already on top
+        boost_item = next(s.item for s in base.item_scores[1:]
+                          if s.score > 0)
+        # boost it massively via the live constraint
+        storage.get_levents().insert(
+            ev("$set", "constraint", "weightedItems",
+               props={"weights": [
+                   {"items": [boost_item], "weight": 1000.0}]}), app)
+        boosted = algo.predict(model, Query(user="u0", num=8))
+        assert boosted.item_scores[0].item == boost_item
+        # removing the constraint restores default weights
+        storage.get_levents().insert(
+            ev("$set", "constraint", "weightedItems",
+               props={"weights": []},
+               t=T0 + dt.timedelta(seconds=5)), app)
+        restored = algo.predict(model, Query(user="u0", num=8))
+        assert restored.item_scores[0].item == base.item_scores[0].item
 
     def test_unseen_only(self, app):
         from predictionio_tpu.templates.ecommercerecommendation import Query
