@@ -8,8 +8,11 @@ is needed — collectives are compiled into the program.
 """
 
 from predictionio_tpu.parallel.mesh import data_parallel_mesh, mesh_2d
-from predictionio_tpu.parallel.als_sharding import train_als_sharded
+from predictionio_tpu.parallel.als_sharding import (
+    train_als_sharded,
+    train_als_sharded_2d,
+)
 from predictionio_tpu.ops.attention import ring_attention  # sequence parallel
 
 __all__ = ["data_parallel_mesh", "mesh_2d", "train_als_sharded",
-           "ring_attention"]
+           "train_als_sharded_2d", "ring_attention"]

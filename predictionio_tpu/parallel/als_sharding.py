@@ -10,8 +10,10 @@ sharding propagation turns the per-slice solves + gathers into
 all-gather/psum collectives over ICI, replacing the Spark shuffle.
 
 Memory note: replicated factors cost ``(N+M) * R * 4`` bytes per device —
-fine through MovieLens-20M (~165 MB at R=128). A 2-D ``(data, model)``
-factor-sharded variant is the next scale step (mesh_2d is ready for it).
+fine through MovieLens-20M (~165 MB at R=128). Past that,
+``train_als_sharded_2d`` shards the factor matrices over the mesh's
+``model`` axis (per-device factor memory drops by the model-axis size;
+one transient all-gather per half-step over ICI — the ALX layout).
 """
 
 from __future__ import annotations
@@ -36,54 +38,41 @@ def _pad_rows_to(arr: np.ndarray, n: int) -> np.ndarray:
     return np.concatenate([arr, pad], axis=0)
 
 
-def train_als_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
-                      params: ALSParams, mesh,
-                      dtype=None) -> Tuple[np.ndarray, np.ndarray]:
-    """Train with rating tables sharded over ``mesh`` axis 'data'.
-
-    Produces the same numerics as :func:`~predictionio_tpu.ops.als.train_als`
-    (same init, same solves) — verified by tests on the virtual CPU mesh.
-    """
+def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
+                   params: ALSParams, mesh, row_divisor: int,
+                   factor_spec, dtype) -> Tuple[np.ndarray, np.ndarray]:
+    """Shared sharded-training body: pad rows to ``row_divisor``, shard
+    rating tables over 'data', place factors per ``factor_spec``, run the
+    full iteration scan, slice padding back off."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    n_dev = mesh.devices.size
     X, Y = init_factors(user_side.n_rows, user_side.n_cols, params.rank,
                         params.seed, dtype)
-
-    # Pad row counts to a multiple of the mesh size so shards are even.
-    n_u = -(-user_side.n_rows // n_dev) * n_dev
-    n_i = -(-item_side.n_rows // n_dev) * n_dev
-    u_cols = _pad_rows_to(user_side.cols, n_u)
-    u_w = _pad_rows_to(user_side.weights, n_u)
-    u_m = _pad_rows_to(user_side.mask, n_u)
-    i_cols = _pad_rows_to(item_side.cols, n_i)
-    i_w = _pad_rows_to(item_side.weights, n_i)
-    i_m = _pad_rows_to(item_side.mask, n_i)
-    X = _pad_rows_to(np.asarray(X), n_u)
-    Y = _pad_rows_to(np.asarray(Y), n_i)
+    n_u = -(-user_side.n_rows // row_divisor) * row_divisor
+    n_i = -(-item_side.n_rows // row_divisor) * row_divisor
 
     row_sharded = NamedSharding(mesh, P("data", None))
-    replicated = NamedSharding(mesh, P(None, None))
+    factor_sharded = NamedSharding(mesh, factor_spec)
+    put = jax.device_put
 
-    u_cols = jax.device_put(jnp.asarray(u_cols), row_sharded)
-    u_w = jax.device_put(jnp.asarray(u_w), row_sharded)
-    u_m = jax.device_put(jnp.asarray(u_m), row_sharded)
-    i_cols = jax.device_put(jnp.asarray(i_cols), row_sharded)
-    i_w = jax.device_put(jnp.asarray(i_w), row_sharded)
-    i_m = jax.device_put(jnp.asarray(i_m), row_sharded)
-    X = jax.device_put(jnp.asarray(X), replicated)
-    Y = jax.device_put(jnp.asarray(Y), replicated)
+    def rows(side, n):
+        return [put(jnp.asarray(_pad_rows_to(a, n)), row_sharded)
+                for a in (side.cols, side.weights, side.mask)]
+
+    u_cols, u_w, u_m = rows(user_side, n_u)
+    i_cols, i_w, i_m = rows(item_side, n_i)
+    X = put(jnp.asarray(_pad_rows_to(np.asarray(X), n_u)), factor_sharded)
+    Y = put(jnp.asarray(_pad_rows_to(np.asarray(Y), n_i)), factor_sharded)
 
     step = jax.jit(
         _als_iterations_impl,
         static_argnames=("lam", "alpha", "implicit", "num_iterations"),
-        # Keep factor outputs replicated: each half-step's solve output is
-        # row-sharded; forcing replication here makes XLA all-gather it
-        # before the next gather-by-index — the ICI analog of MLlib's
-        # factor shuffle.
-        out_shardings=(replicated, replicated),
+        # factor outputs keep factor_spec between iterations; XLA inserts
+        # the collectives (all-gather before each index-gather — the ICI
+        # analog of MLlib's factor shuffle)
+        out_shardings=(factor_sharded, factor_sharded),
     )
     X, Y = step(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m,
                 lam=float(params.lambda_), alpha=float(params.alpha),
@@ -91,6 +80,44 @@ def train_als_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
                 num_iterations=int(params.num_iterations))
     return (np.asarray(X)[:user_side.n_rows],
             np.asarray(Y)[:item_side.n_rows])
+
+
+def train_als_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
+                      params: ALSParams, mesh,
+                      dtype=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Train with rating tables sharded over ``mesh`` axis 'data' and
+    factor matrices replicated.
+
+    Produces the same numerics as :func:`~predictionio_tpu.ops.als.train_als`
+    (same init, same solves) — verified by tests on the virtual CPU mesh.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return _train_sharded(user_side, item_side, params, mesh,
+                          row_divisor=mesh.devices.size,
+                          factor_spec=P(None, None), dtype=dtype)
+
+
+def train_als_sharded_2d(user_side: PaddedRatings, item_side: PaddedRatings,
+                         params: ALSParams, mesh,
+                         dtype=None) -> Tuple[np.ndarray, np.ndarray]:
+    """2-D (data x model) sharded training: rating tables row-sharded over
+    'data', FACTOR MATRICES row-sharded over 'model'.
+
+    This is the scale step beyond replicated factors (module docstring):
+    each device stores only ``rows/model_size`` of each factor matrix in
+    HBM; GSPMD all-gathers the fixed side transiently for the gather-by-
+    index of each half-step and scatters the solve output back to its
+    shard — factor memory per device drops by the model-axis size at the
+    cost of one all-gather per half-step over ICI (the ALX layout).
+    Numerics identical to :func:`~predictionio_tpu.ops.als.train_als`.
+    Rows pad to a multiple of data*model so BOTH shardings split evenly.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return _train_sharded(user_side, item_side, params, mesh,
+                          row_divisor=mesh.shape["data"] * mesh.shape["model"],
+                          factor_spec=P("model", None), dtype=dtype)
 
 
 def sharded_train_step(mesh, rank: int, params: Optional[ALSParams] = None):
