@@ -9,6 +9,7 @@ Parity targets: ``PEventStore`` (``data/.../store/PEventStore.scala:30-116``),
 from __future__ import annotations
 
 import datetime as _dt
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.data import storage
@@ -99,11 +100,88 @@ class PEventStore:
             strict=strict)
 
 
+class LEventStoreTimeoutError(TimeoutError):
+    """Predict-time read exceeded its deadline (the reference's
+    TimeoutException from Await.result, LEventStore.scala:58)."""
+
+
+class _DaemonReadPool:
+    """Minimal worker pool with DAEMON threads for deadline-bounded reads.
+
+    ``concurrent.futures.ThreadPoolExecutor`` joins its (non-daemon)
+    workers at interpreter exit — a permanently wedged read (exactly the
+    scenario the pool guards against) would hang process shutdown.
+    Daemon workers match every other background thread in the codebase.
+    """
+
+    def __init__(self, max_workers: int = 16):
+        import queue
+
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._max_workers = max_workers
+        self._spawned = 0
+        self._lock = threading.Lock()
+
+    def _worker(self) -> None:
+        while True:
+            fn, box, done = self._tasks.get()
+            try:
+                box.append((True, fn()))
+            except BaseException as e:  # delivered to the waiter
+                box.append((False, e))
+            finally:
+                done.set()
+
+    def submit(self, fn):
+        with self._lock:
+            # grow lazily up to the cap (a wedged worker never returns,
+            # so permanently losing threads to wedged reads is bounded)
+            if self._spawned < self._max_workers:
+                self._spawned += 1
+                t = threading.Thread(target=self._worker, daemon=True,
+                                     name=f"pio-leventstore-{self._spawned}")
+                t.start()
+        box: list = []
+        done = threading.Event()
+        self._tasks.put((fn, box, done))
+        return box, done
+
+
+_read_pool = None
+_read_pool_lock = threading.Lock()
+
+
+def _pool() -> _DaemonReadPool:
+    global _read_pool
+    with _read_pool_lock:
+        if _read_pool is None:
+            _read_pool = _DaemonReadPool()
+        return _read_pool
+
+
+def _bounded(fn, timeout: Optional[float]):
+    """Run ``fn`` with an optional deadline (seconds). ``None`` = direct
+    call (no extra thread hop on the common local-backend path)."""
+    if timeout is None:
+        return fn()
+    box, done = _pool().submit(fn)
+    if not done.wait(timeout):
+        raise LEventStoreTimeoutError(
+            f"event-store read exceeded {timeout}s")
+    ok, value = box[0]
+    if ok:
+        return value
+    raise value
+
+
 class LEventStore:
     """Low-latency reads at predict time (LEventStore.scala:58,114).
 
-    The reference exposes blocking calls with a timeout; our sqlite/memory
-    backends are local so calls are direct.
+    The reference's calls block with a ``timeout: Duration``; here
+    ``timeout`` (seconds) bounds the read the same way — predict-time
+    constraint lookups are on the serving hot path, and a wedged backend
+    must surface as a fast ``LEventStoreTimeoutError`` (which templates
+    catch and degrade on), not a stalled query. ``None`` runs direct.
     """
 
     @staticmethod
@@ -119,14 +197,22 @@ class LEventStore:
         until_time: Optional[_dt.datetime] = None,
         limit: Optional[int] = None,
         latest: bool = True,
+        timeout: Optional[float] = None,
     ) -> List[Event]:
-        app_id, channel_id = app_name_to_id(app_name, channel_name)
-        return list(storage.get_levents().find(
-            app_id=app_id, channel_id=channel_id, start_time=start_time,
-            until_time=until_time, entity_type=entity_type,
-            entity_id=entity_id, event_names=event_names,
-            target_entity_type=target_entity_type,
-            target_entity_id=target_entity_id, limit=limit, reversed=latest))
+        def read():
+            # the metadata lookup hits the same backend — it must run
+            # under the deadline too, or a wedged store stalls the caller
+            # before _bounded is ever reached
+            app_id, channel_id = app_name_to_id(app_name, channel_name)
+            return list(storage.get_levents().find(
+                app_id=app_id, channel_id=channel_id, start_time=start_time,
+                until_time=until_time, entity_type=entity_type,
+                entity_id=entity_id, event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id, limit=limit,
+                reversed=latest))
+
+        return _bounded(read, timeout)
 
     @staticmethod
     def find(
@@ -140,11 +226,16 @@ class LEventStore:
         target_entity_type: Any = UNSET,
         target_entity_id: Any = UNSET,
         limit: Optional[int] = None,
+        timeout: Optional[float] = None,
     ) -> List[Event]:
-        app_id, channel_id = app_name_to_id(app_name, channel_name)
-        return list(storage.get_levents().find(
-            app_id=app_id, channel_id=channel_id, start_time=start_time,
-            until_time=until_time, entity_type=entity_type,
-            entity_id=entity_id, event_names=event_names,
-            target_entity_type=target_entity_type,
-            target_entity_id=target_entity_id, limit=limit))
+        def read():
+            # metadata lookup under the deadline too (see find_by_entity)
+            app_id, channel_id = app_name_to_id(app_name, channel_name)
+            return list(storage.get_levents().find(
+                app_id=app_id, channel_id=channel_id, start_time=start_time,
+                until_time=until_time, entity_type=entity_type,
+                entity_id=entity_id, event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id, limit=limit))
+
+        return _bounded(read, timeout)

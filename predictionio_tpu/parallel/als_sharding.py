@@ -38,6 +38,22 @@ def _pad_rows_to(arr: np.ndarray, n: int) -> np.ndarray:
     return np.concatenate([arr, pad], axis=0)
 
 
+def _jit_step(mesh, factor_spec):
+    """The production jitted iteration program: factor outputs pinned to
+    ``factor_spec`` between iterations; XLA inserts the collectives
+    (all-gather before each index-gather — the ICI analog of MLlib's
+    factor shuffle)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    factor_sharded = NamedSharding(mesh, factor_spec)
+    return jax.jit(
+        _als_iterations_impl,
+        static_argnames=("lam", "alpha", "implicit", "num_iterations"),
+        out_shardings=(factor_sharded, factor_sharded),
+    )
+
+
 def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
                    params: ALSParams, mesh, row_divisor: int,
                    factor_spec, dtype) -> Tuple[np.ndarray, np.ndarray]:
@@ -66,14 +82,7 @@ def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
     X = put(jnp.asarray(_pad_rows_to(np.asarray(X), n_u)), factor_sharded)
     Y = put(jnp.asarray(_pad_rows_to(np.asarray(Y), n_i)), factor_sharded)
 
-    step = jax.jit(
-        _als_iterations_impl,
-        static_argnames=("lam", "alpha", "implicit", "num_iterations"),
-        # factor outputs keep factor_spec between iterations; XLA inserts
-        # the collectives (all-gather before each index-gather — the ICI
-        # analog of MLlib's factor shuffle)
-        out_shardings=(factor_sharded, factor_sharded),
-    )
+    step = _jit_step(mesh, factor_spec)
     X, Y = step(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m,
                 lam=float(params.lambda_), alpha=float(params.alpha),
                 implicit=bool(params.implicit_prefs),

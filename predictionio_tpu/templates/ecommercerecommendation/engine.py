@@ -208,7 +208,7 @@ class ECommAlgorithm(P2LAlgorithm):
             events = LEventStore.find_by_entity(
                 app_name=p.app_name, entity_type="user",
                 entity_id=query.user, event_names=list(p.seen_events),
-                target_entity_type="item")
+                target_entity_type="item", timeout=10.0)
         except Exception as e:
             logger.error("Error when reading seen events: %s", e)
             return set()
@@ -223,7 +223,7 @@ class ECommAlgorithm(P2LAlgorithm):
             events = list(LEventStore.find_by_entity(
                 app_name=p.app_name, entity_type="constraint",
                 entity_id="unavailableItems", event_names=["$set"],
-                latest=True, limit=1))
+                latest=True, limit=1, timeout=0.2))
         except Exception as e:
             logger.error("Error when reading unavailableItems: %s", e)
             return set()
@@ -241,7 +241,7 @@ class ECommAlgorithm(P2LAlgorithm):
             events = list(LEventStore.find_by_entity(
                 app_name=p.app_name, entity_type="constraint",
                 entity_id="weightedItems", event_names=["$set"],
-                latest=True, limit=1))
+                latest=True, limit=1, timeout=0.2))
         except Exception as e:
             logger.error("Error when reading set weightedItems event: %s", e)
             return None
@@ -273,7 +273,8 @@ class ECommAlgorithm(P2LAlgorithm):
             events = LEventStore.find_by_entity(
                 app_name=p.app_name, entity_type="user",
                 entity_id=query.user, event_names=list(p.similar_events),
-                target_entity_type="item", latest=True, limit=10)
+                target_entity_type="item", latest=True, limit=10,
+                timeout=10.0)
         except Exception as e:
             logger.error("Error when reading recent events: %s", e)
             return None

@@ -1,0 +1,63 @@
+"""LEventStore deadline-bounded predict-time reads (LEventStore.scala's
+timeout semantics)."""
+
+import time
+
+import pytest
+
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.store import (
+    LEventStore,
+    LEventStoreTimeoutError,
+)
+from predictionio_tpu.data.storage.base import App
+
+
+@pytest.fixture
+def app(mem_storage):
+    aid = storage.get_metadata_apps().insert(App(0, "toapp"))
+    le = storage.get_levents()
+    le.init(aid)
+    le.insert(Event(event="view", entity_type="user", entity_id="u1",
+                    target_entity_type="item", target_entity_id="i1"), aid)
+    return aid
+
+
+class TestTimeout:
+    def test_direct_path_no_timeout(self, app):
+        events = LEventStore.find_by_entity(
+            app_name="toapp", entity_type="user", entity_id="u1")
+        assert len(events) == 1
+
+    def test_bounded_read_succeeds(self, app):
+        events = LEventStore.find_by_entity(
+            app_name="toapp", entity_type="user", entity_id="u1",
+            timeout=5.0)
+        assert len(events) == 1
+        events = LEventStore.find(app_name="toapp", entity_type="user",
+                                  timeout=5.0)
+        assert len(events) == 1
+
+    def test_wedged_backend_times_out(self, app, monkeypatch):
+        real = storage.get_levents().find
+
+        def slow_find(*a, **kw):
+            time.sleep(3.0)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(type(storage.get_levents()), "find",
+                            lambda self, *a, **kw: slow_find(*a, **kw))
+        t0 = time.perf_counter()
+        with pytest.raises(LEventStoreTimeoutError):
+            LEventStore.find_by_entity(
+                app_name="toapp", entity_type="user", entity_id="u1",
+                timeout=0.2)
+        # the caller gets control back at ~the deadline, not after 3s
+        assert time.perf_counter() - t0 < 1.5
+
+    def test_timeout_error_is_catchable_as_exception(self, app, monkeypatch):
+        """Templates catch plain Exception around constraint reads; the
+        timeout error must land in those handlers."""
+        assert issubclass(LEventStoreTimeoutError, TimeoutError)
+        assert issubclass(LEventStoreTimeoutError, Exception)

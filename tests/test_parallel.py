@@ -85,30 +85,28 @@ class TestShardedALS2D:
         np.testing.assert_allclose(Y2, Y1, rtol=1e-4, atol=1e-5)
 
     def test_factors_stay_sharded_in_hbm(self, mesh2d):
-        """The compiled program's factor outputs are sharded over the
-        model axis — per-device factor memory is rows/model_size."""
+        """The PRODUCTION step program (the one _train_sharded runs)
+        keeps factor outputs sharded over the model axis — per-device
+        factor memory is rows/model_size."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from predictionio_tpu.ops.als import _als_iterations_impl
-        from predictionio_tpu.parallel.als_sharding import _pad_rows_to
+        from predictionio_tpu.parallel.als_sharding import _jit_step
 
         rows, cols, vals = synthetic_ratings(32, 16, 3, 0.4, seed=4)
         user_side = pad_ratings(rows, cols, vals, 32, 16)
         item_side = pad_ratings(cols, rows, vals, 16, 32)
-        factor_sharded = NamedSharding(mesh2d, P("model", None))
         row_sharded = NamedSharding(mesh2d, P("data", None))
         put = jax.device_put
-        X = put(jnp.zeros((32, 4)), factor_sharded)
-        Y = put(jnp.zeros((16, 4)), factor_sharded)
+        X = put(jnp.zeros((32, 4)),
+                NamedSharding(mesh2d, P("model", None)))
+        Y = put(jnp.zeros((16, 4)),
+                NamedSharding(mesh2d, P("model", None)))
         args = [put(jnp.asarray(a), row_sharded) for a in (
             user_side.cols, user_side.weights, user_side.mask,
             item_side.cols, item_side.weights, item_side.mask)]
-        step = jax.jit(_als_iterations_impl,
-                       static_argnames=("lam", "alpha", "implicit",
-                                       "num_iterations"),
-                       out_shardings=(factor_sharded, factor_sharded))
+        step = _jit_step(mesh2d, P("model", None))  # production builder
         Xo, Yo = step(X, Y, *args, lam=0.01, alpha=1.0, implicit=True,
                       num_iterations=1)
         assert Xo.sharding.spec == P("model", None)
