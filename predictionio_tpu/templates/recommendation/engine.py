@@ -318,6 +318,62 @@ class RecommendationServing(LFirstServing):
     """First-serving (template Serving.scala returns the single result)."""
 
 
+class PrecisionAtK(OptionAverageMetric):
+    """Precision@k on top-N recommendations — the BASELINE.md quality
+    parity metric (mirrors the reference's movielens evaluation example,
+    ``examples/experimental/scala-parallel-recommendation-mlc/``): for
+    each (query, predicted, actual), the fraction of the top-k
+    recommended items that appear in the held-out actuals; None (skipped)
+    when the user has no actuals."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"Precision@{self.k}"
+
+    def calculate_qpa(self, q: Query, p: PredictedResult,
+                      a: ActualResult) -> Optional[float]:
+        if not a.items:
+            return None
+        actual = set(a.items)
+        top = [s.item for s in p.item_scores[:self.k]]
+        if not top:
+            return 0.0
+        return sum(1 for i in top if i in actual) / float(self.k)
+
+
+class RecommendationEvaluation(Evaluation):
+    """`pio eval` entry: ALS grid scored by Precision@10; best params
+    land in best.json (Evaluation.scala engine_metric path)."""
+
+    def __init__(self, app_name: str = "recommendation-app", k: int = 10):
+        super().__init__()
+        self.engine_metric = (engine_factory(), PrecisionAtK(k))
+        # convenience: carry a default grid so `pio eval` needs no extra
+        # generator class (set app_name via constructor/engine params)
+        self._app_name = app_name
+
+
+class RecommendationParamsList(EngineParamsGenerator):
+    """Default tuning grid over rank/lambda (EngineParamsGenerator
+    analog used by the reference's evaluation templates)."""
+
+    def __init__(self, app_name: str = "recommendation-app"):
+        super().__init__()
+        self.engine_params_list = [
+            EngineParams(
+                data_source_params=("", DataSourceParams(app_name=app_name)),
+                algorithm_params_list=[
+                    ("als", ALSParams(rank=rank, num_iterations=10,
+                                      lambda_=lam, seed=3))],
+            )
+            for rank in (8, 16)
+            for lam in (0.01, 0.1)
+        ]
+
+
 def engine_factory() -> Engine:
     """EngineFactory analog (custom-query Engine.scala:13-19)."""
     return Engine(
