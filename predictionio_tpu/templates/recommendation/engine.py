@@ -26,8 +26,12 @@ import numpy as np
 
 from predictionio_tpu.controller import (
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     LFirstServing,
     LServing,
+    OptionAverageMetric,
     P2LAlgorithm,
     Params,
     PDataSource,
@@ -344,18 +348,6 @@ class PrecisionAtK(OptionAverageMetric):
         return sum(1 for i in top if i in actual) / float(self.k)
 
 
-class RecommendationEvaluation(Evaluation):
-    """`pio eval` entry: ALS grid scored by Precision@10; best params
-    land in best.json (Evaluation.scala engine_metric path)."""
-
-    def __init__(self, app_name: str = "recommendation-app", k: int = 10):
-        super().__init__()
-        self.engine_metric = (engine_factory(), PrecisionAtK(k))
-        # convenience: carry a default grid so `pio eval` needs no extra
-        # generator class (set app_name via constructor/engine params)
-        self._app_name = app_name
-
-
 class RecommendationParamsList(EngineParamsGenerator):
     """Default tuning grid over rank/lambda (EngineParamsGenerator
     analog used by the reference's evaluation templates)."""
@@ -372,6 +364,21 @@ class RecommendationParamsList(EngineParamsGenerator):
             for rank in (8, 16)
             for lam in (0.01, 0.1)
         ]
+
+
+class RecommendationEvaluation(Evaluation, RecommendationParamsList):
+    """`pio eval` entry: ALS grid scored by Precision@10; best params
+    land in best.json (Evaluation.scala engine_metric path).
+
+    Also an EngineParamsGenerator (like the reference's evaluation
+    templates that extend both), so ``pio eval <this-class>`` needs no
+    separate generator argument and ``app_name`` reaches the
+    datasource params of every grid point."""
+
+    def __init__(self, app_name: str = "recommendation-app", k: int = 10):
+        Evaluation.__init__(self)
+        RecommendationParamsList.__init__(self, app_name=app_name)
+        self.engine_metric = (engine_factory(), PrecisionAtK(k))
 
 
 def engine_factory() -> Engine:

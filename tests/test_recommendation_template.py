@@ -1,6 +1,7 @@
 """End-to-end recommendation template test: events -> train -> persist ->
 reload -> predict (the SURVEY §7 minimum slice, in-process)."""
 
+import json
 import datetime as dt
 
 import numpy as np
@@ -153,3 +154,74 @@ class TestTemplate:
         })
         models = engine.train(CTX, params, "t6")
         assert models[0].user_factors.shape[1] == 4
+
+
+class TestEvaluation:
+    """PrecisionAtK + the tuning grid + the `pio eval` dataflow
+    (MetricEvaluator.scala:190-246 over ALSAlgorithm.scala:64-103)."""
+
+    def test_precision_at_k_math(self):
+        from predictionio_tpu.templates.recommendation.engine import (
+            ActualResult, ItemScore, PrecisionAtK)
+        m = PrecisionAtK(k=4)
+        assert m.header == "Precision@4"
+        q = Query(user="u", num=4)
+        p = PredictedResult(tuple(
+            ItemScore(i, 1.0) for i in ("a", "b", "c", "d", "e")))
+        # only top-k counts: a,b,c,d considered; hits a,c -> 2/4
+        assert m.calculate_qpa(q, p, ActualResult(["a", "c", "e"])) == 0.5
+        # no actuals -> skipped (None), not zero
+        assert m.calculate_qpa(q, p, ActualResult([])) is None
+        # no predictions -> 0.0
+        assert m.calculate_qpa(
+            q, PredictedResult(()), ActualResult(["a"])) == 0.0
+
+    def test_grid_generator_carries_app_name(self):
+        from predictionio_tpu.templates.recommendation.engine import (
+            RecommendationParamsList)
+        grid = RecommendationParamsList(app_name="recapp").engine_params_list
+        assert len(grid) == 4
+        assert {ep.data_source_params[1].app_name for ep in grid} == {"recapp"}
+        combos = {(ep.algorithm_params_list[0][1].rank,
+                   ep.algorithm_params_list[0][1].lambda_) for ep in grid}
+        assert combos == {(8, 0.01), (8, 0.1), (16, 0.01), (16, 0.1)}
+
+    def test_evaluation_is_generator_with_app_name(self):
+        from predictionio_tpu.templates.recommendation.engine import (
+            EngineParamsGenerator, Evaluation, RecommendationEvaluation)
+        ev = RecommendationEvaluation(app_name="otherapp", k=3)
+        assert isinstance(ev, Evaluation)
+        assert isinstance(ev, EngineParamsGenerator)
+        assert all(ep.data_source_params[1].app_name == "otherapp"
+                   for ep in ev.engine_params_list)
+        assert ev.evaluator.metric.k == 3
+
+    def test_run_evaluation_end_to_end_writes_best_json(
+            self, rated_app, tmp_path, monkeypatch):
+        """Full holdout eval over a 2-point grid -> MetricEvaluatorResult
+        with a real Precision@10 and a trainable best.json."""
+        from predictionio_tpu.data.storage.base import EvaluationInstance
+        from predictionio_tpu.templates.recommendation.engine import (
+            RecommendationEvaluation)
+        from predictionio_tpu.workflow import run_evaluation
+
+        monkeypatch.chdir(tmp_path)  # best.json lands in CWD
+        ev = RecommendationEvaluation(app_name="recapp", k=10)
+        now = dt.datetime.now(tz=dt.timezone.utc)
+        instance = EvaluationInstance(
+            id="", status="INIT", start_time=now, end_time=now,
+            evaluation_class="rec-eval", engine_params_generator_class="",
+            batch="", env={})
+        result = run_evaluation(
+            ev.engine, ev.engine_params_list[:2], instance, ev.evaluator,
+            evaluation=ev, ctx=CTX)
+        assert result.metric_header == "Precision@10"
+        assert 0.0 <= result.best_score.score <= 1.0
+        assert len(result.engine_params_scores) == 2
+        best = json.loads((tmp_path / "best.json").read_text())
+        assert "RecommendationEvaluation" in best["engineFactory"]
+        assert best["algorithms"][0]["params"]["rank"] in (8, 16)
+        # the recorded EvaluationInstance reached EVALCOMPLETED
+        insts = storage.get_metadata_evaluation_instances()
+        done = insts.get_completed()
+        assert done and done[0].status == "EVALCOMPLETED"
