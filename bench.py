@@ -143,6 +143,11 @@ def main() -> None:
     scale_total, _ = timed_training(us1, is1, params, repeats=2)
     scale_epoch = scale_total / ITERATIONS
 
+    # quality parity (the second BASELINE target): Precision@10 of the
+    # device ALS vs the CPU reference on the same holdout split
+    import bench_quality
+    quality = bench_quality.run()
+
     import jax
 
     print(json.dumps({
@@ -162,6 +167,7 @@ def main() -> None:
                 "events_processed": processed1,
                 "events_per_sec": round(processed1 / scale_epoch, 1),
             },
+            "quality": quality,
         },
     }))
 
