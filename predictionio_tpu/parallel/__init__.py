@@ -12,7 +12,13 @@ from predictionio_tpu.parallel.als_sharding import (
     train_als_sharded,
     train_als_sharded_2d,
 )
+from predictionio_tpu.parallel import distributed  # multi-host runtime
+from predictionio_tpu.parallel.distributed import (
+    DistributedConfig,
+    host_aware_mesh,
+)
 from predictionio_tpu.ops.attention import ring_attention  # sequence parallel
 
 __all__ = ["data_parallel_mesh", "mesh_2d", "train_als_sharded",
-           "train_als_sharded_2d", "ring_attention"]
+           "train_als_sharded_2d", "ring_attention", "distributed",
+           "DistributedConfig", "host_aware_mesh"]

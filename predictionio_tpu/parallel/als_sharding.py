@@ -72,21 +72,55 @@ def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
     row_sharded = NamedSharding(mesh, P("data", None))
     factor_sharded = NamedSharding(mesh, factor_spec)
     put = jax.device_put
+    # keyed on the MESH, not jax.process_count(): a local mesh inside a
+    # distributed runtime must still take the single-host placement path
+    multi_host = len({d.process_index for d in mesh.devices.flat}) > 1
+
+    def place_rows(a, n):
+        """Rating-table rows, sharded over 'data'. Multi-host: each host
+        contributes only its contiguous row block (host-sharded ingest,
+        parallel/distributed.py); single-host: plain device_put."""
+        a = _pad_rows_to(a, n)
+        if multi_host:
+            from predictionio_tpu.parallel import distributed
+
+            start, stop = distributed.process_row_block(n)
+            return distributed.make_global_array(mesh, P("data", None),
+                                                 a[start:stop])
+        return put(jnp.asarray(a), row_sharded)
+
+    def place_factor(a, n):
+        """Factor matrices: replicated or model-axis sharded. With
+        host_aware_mesh's host-local model groups every host holds all
+        model positions, so its process-local data is the full matrix."""
+        a = _pad_rows_to(np.asarray(a), n)
+        if multi_host:
+            from predictionio_tpu.parallel import distributed
+
+            return distributed.make_global_array(mesh, factor_spec, a)
+        return put(jnp.asarray(a), factor_sharded)
 
     def rows(side, n):
-        return [put(jnp.asarray(_pad_rows_to(a, n)), row_sharded)
-                for a in (side.cols, side.weights, side.mask)]
+        return [place_rows(a, n) for a in (side.cols, side.weights,
+                                           side.mask)]
 
     u_cols, u_w, u_m = rows(user_side, n_u)
     i_cols, i_w, i_m = rows(item_side, n_i)
-    X = put(jnp.asarray(_pad_rows_to(np.asarray(X), n_u)), factor_sharded)
-    Y = put(jnp.asarray(_pad_rows_to(np.asarray(Y), n_i)), factor_sharded)
+    X = place_factor(X, n_u)
+    Y = place_factor(Y, n_i)
 
     step = _jit_step(mesh, factor_spec)
     X, Y = step(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m,
                 lam=float(params.lambda_), alpha=float(params.alpha),
                 implicit=bool(params.implicit_prefs),
                 num_iterations=int(params.num_iterations))
+    if multi_host:
+        # factors are needed host-side on every host (model persistence,
+        # serving); gather across processes over DCN
+        from jax.experimental import multihost_utils
+
+        X = multihost_utils.process_allgather(X, tiled=True)
+        Y = multihost_utils.process_allgather(Y, tiled=True)
     return (np.asarray(X)[:user_side.n_rows],
             np.asarray(Y)[:item_side.n_rows])
 
@@ -127,6 +161,35 @@ def train_als_sharded_2d(user_side: PaddedRatings, item_side: PaddedRatings,
     return _train_sharded(user_side, item_side, params, mesh,
                           row_divisor=mesh.shape["data"] * mesh.shape["model"],
                           factor_spec=P("model", None), dtype=dtype)
+
+
+def train_als_auto(user_side: PaddedRatings, item_side: PaddedRatings,
+                   params: ALSParams, dtype=None
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Topology-aware trainer — what the templates call.
+
+    Multi-host runtime (``pio train --num-hosts K``): a global host-aware
+    mesh so all hosts train ONE collective program over DCN+ICI.
+    Single host, multiple devices: data-parallel over the local mesh.
+    One device: the plain jitted path. Numerics are identical across all
+    three (same init, same solves; tested on the virtual mesh).
+    """
+    import jax
+
+    from predictionio_tpu.ops.als import train_als
+
+    if jax.process_count() > 1:
+        from predictionio_tpu.parallel import distributed
+
+        mesh = distributed.host_aware_mesh()
+        return train_als_sharded(user_side, item_side, params, mesh,
+                                 dtype=dtype)
+    from predictionio_tpu.parallel.mesh import data_parallel_mesh
+
+    if len(jax.devices()) > 1:
+        return train_als_sharded(user_side, item_side, params,
+                                 data_parallel_mesh(), dtype=dtype)
+    return train_als(user_side, item_side, params, dtype=dtype)
 
 
 def sharded_train_step(mesh, rank: int, params: Optional[ALSParams] = None):

@@ -1,0 +1,209 @@
+"""Multi-host runtime: the cluster-launch plane of the framework.
+
+Reference analog: the `pio` CLI assembles a ``spark-submit`` command that
+carries the whole cluster topology (``tools/src/main/scala/io/prediction/
+tools/Runner.scala:92-210``); Spark's driver/executor processes then form
+the cluster. Here the runner *is* the host process: every host runs the
+same ``pio train ... --num-hosts K --coordinator HOST:PORT --process-id i``
+command, :func:`initialize` connects them over DCN via
+``jax.distributed.initialize``, and from then on ``jax.devices()`` is the
+GLOBAL device set, meshes span all hosts, and XLA routes collectives over
+ICI within a host/slice and DCN across hosts (SURVEY §2.6 comm row).
+
+Single-process is the degenerate case: :func:`initialize` is a no-op when
+``num_hosts <= 1`` and no coordinator is given, so the same engine code
+runs unchanged on one host (the path every test and the driver's
+``dryrun_multichip`` exercise).
+
+Launch recipe (K hosts, same code on each)::
+
+    # host 0 (also the coordinator)
+    pio train ... --num-hosts K --coordinator host0:8476 --process-id 0
+    # host i
+    pio train ... --num-hosts K --coordinator host0:8476 --process-id i
+
+Per-host ingest sharding: each host reads only its contiguous block of
+training rows (:func:`process_row_block`) and contributes it to the
+globally-sharded array with :func:`make_global_array` — the analog of the
+reference's executor-local partition reads (``JDBCPEvents.scala:31-100``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence, Tuple
+
+_INITIALIZED = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Topology flags (CLI ``--coordinator/--num-hosts/--process-id`` or
+    ``PIO_COORDINATOR/PIO_NUM_HOSTS/PIO_PROCESS_ID`` env — the env path
+    mirrors the reference's PIO_* forwarding, Runner.scala:119-121)."""
+
+    coordinator: Optional[str] = None     # "host:port"
+    num_hosts: int = 1
+    process_id: Optional[int] = None
+    local_device_ids: Optional[Tuple[int, ...]] = None
+
+    @classmethod
+    def from_env(cls) -> "DistributedConfig":
+        ids = os.environ.get("PIO_LOCAL_DEVICE_IDS")
+        return cls(
+            coordinator=os.environ.get("PIO_COORDINATOR") or None,
+            num_hosts=int(os.environ.get("PIO_NUM_HOSTS", "1")),
+            process_id=(int(os.environ["PIO_PROCESS_ID"])
+                        if "PIO_PROCESS_ID" in os.environ else None),
+            local_device_ids=(tuple(int(x) for x in ids.split(","))
+                              if ids else None),
+        )
+
+    @classmethod
+    def from_args(cls, args) -> "DistributedConfig":
+        """Build from argparse flags, falling back to the env scheme."""
+        env = cls.from_env()
+        return cls(
+            coordinator=getattr(args, "coordinator", None) or env.coordinator,
+            num_hosts=(getattr(args, "num_hosts", None) or env.num_hosts),
+            process_id=(getattr(args, "process_id", None)
+                        if getattr(args, "process_id", None) is not None
+                        else env.process_id),
+            local_device_ids=env.local_device_ids,
+        )
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1 or self.coordinator is not None
+
+
+def initialize(config: Optional[DistributedConfig] = None) -> bool:
+    """Connect this process to the multi-host runtime.
+
+    Single-process degenerate case (``num_hosts <= 1``, no coordinator):
+    no-op, returns False — ``jax.process_count() == 1`` and every mesh
+    helper below still works. Multi-host: calls
+    ``jax.distributed.initialize`` (idempotent per process) and returns
+    True; after it, ``jax.devices()`` is global and ``jax.local_devices()``
+    is this host's slice.
+    """
+    global _INITIALIZED
+    config = config or DistributedConfig.from_env()
+    if not config.is_multi_host:
+        return False
+    if _INITIALIZED:
+        return True
+    if not config.coordinator:
+        raise ValueError("--coordinator HOST:PORT is required when "
+                         "--num-hosts > 1")
+    if config.process_id is None:
+        raise ValueError("--process-id is required when --num-hosts > 1 "
+                         "(0..num_hosts-1, unique per host)")
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=config.coordinator,
+        num_processes=config.num_hosts,
+        process_id=config.process_id,
+        local_device_ids=config.local_device_ids,
+    )
+    _INITIALIZED = True
+    return True
+
+
+def shutdown() -> None:
+    """Tear down the distributed client (tests / clean exit)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        import jax
+
+        jax.distributed.shutdown()
+        _INITIALIZED = False
+
+
+def is_primary_host() -> bool:
+    """True on the host that owns metadata/model persistence (host 0 —
+    the reference's Spark *driver* role). Deliberately jax-free in the
+    single-process case so storage-only workflows never touch a backend."""
+    if not _INITIALIZED:
+        return True
+    return process_index() == 0
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def host_aware_mesh(model: int = 1, devices: Optional[Sequence] = None):
+    """Global (data × model) mesh with model-axis groups kept WITHIN a
+    host, so the per-half-step factor all-gathers of the 2-D ALS layout
+    ride ICI while only the data-axis reductions cross DCN (the
+    cheap-axis-inside rule of the scaling playbook).
+
+    With one host this degenerates to :func:`mesh_2d` /
+    :func:`data_parallel_mesh` over the local devices.
+    """
+    import numpy as np
+    import jax
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if model <= 0 or len(devs) % model:
+        raise ValueError(
+            f"model axis {model} must divide device count {len(devs)}")
+    per_host = min(
+        sum(1 for d in devs if d.process_index == p)
+        for p in {d.process_index for d in devs})
+    if model > 1 and per_host % model:
+        raise ValueError(
+            f"model axis {model} must divide the per-host device count "
+            f"{per_host} so model groups stay host-local (otherwise the "
+            "factor all-gathers would cross DCN)")
+    # order by (host, device) so a reshape keeps model groups host-local
+    devs.sort(key=lambda d: (d.process_index, d.id))
+    arr = np.asarray(devs).reshape(len(devs) // model, model)
+    if model == 1:
+        return jax.sharding.Mesh(arr[:, 0], ("data",))
+    return jax.sharding.Mesh(arr, ("data", "model"))
+
+
+def process_row_block(n_rows: int,
+                      index: Optional[int] = None,
+                      count: Optional[int] = None) -> Tuple[int, int]:
+    """Contiguous ``[start, stop)`` row block this host ingests — the
+    executor-partition analog of the reference's time-partitioned reads
+    (``JDBCPEvents.scala:46-48``). Blocks are balanced to within one row;
+    every row belongs to exactly one host."""
+    if index is None:
+        index = process_index()
+    if count is None:
+        count = process_count()
+    if not 0 <= index < count:
+        raise ValueError(f"process index {index} not in [0, {count})")
+    base, extra = divmod(n_rows, count)
+    start = index * base + min(index, extra)
+    stop = start + base + (1 if index < extra else 0)
+    return start, stop
+
+
+def make_global_array(mesh, spec, local_block):
+    """Assemble a globally-sharded array from this host's block.
+
+    ``local_block`` is the rows returned by :func:`process_row_block`
+    (host-sharded ingest); the result is a single jax.Array sharded per
+    ``spec`` over the whole mesh. Works unchanged in the single-process
+    case (block == whole array)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local_block)

@@ -39,12 +39,14 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.core.context import ComputeContext
 from predictionio_tpu.data.bimap import BiMap, StringIndexBiMap
 from predictionio_tpu.data.store import LEventStore, PEventStore
+from predictionio_tpu.parallel.als_sharding import (
+    train_als_auto as _train_als_auto,
+)
 from predictionio_tpu.ops.als import (
     ALSParams,
     cosine_scores,
     pad_ratings,
     predict_scores_for_user,
-    train_als,
 )
 
 logger = logging.getLogger("pio.templates.ecommerce")
@@ -190,7 +192,7 @@ class ECommAlgorithm(P2LAlgorithm):
         vals = np.asarray(list(counts.values()), dtype=np.float32)
         rows, cols = keys[:, 0], keys[:, 1]
         n_u, n_i = len(user_map), len(item_map)
-        X, Y = train_als(
+        X, Y = _train_als_auto(
             pad_ratings(rows, cols, vals, n_u, n_i),
             pad_ratings(cols, rows, vals, n_i, n_u),
             ALSParams(rank=p.rank, num_iterations=p.num_iterations,

@@ -47,7 +47,6 @@ from predictionio_tpu.ops.als import (
     pad_ratings,
     predict_scores_for_user,
     top_k_items,
-    train_als,
 )
 
 
@@ -257,7 +256,11 @@ class ALSAlgorithm(P2LAlgorithm):
     query_cls = Query
 
     def train(self, ctx: ComputeContext, pd: PreparedData) -> ALSModel:
-        X, Y = train_als(pd.user_side, pd.item_side, self.params)
+        # topology-aware: sharded over the (multi-host) mesh when one
+        # exists, single-device otherwise (parallel/als_sharding.py)
+        from predictionio_tpu.parallel.als_sharding import train_als_auto
+
+        X, Y = train_als_auto(pd.user_side, pd.item_side, self.params)
         return ALSModel(X, Y, pd.user_map, pd.item_map, pd.seen)
 
     def warmup_base(self, model: ALSModel) -> None:

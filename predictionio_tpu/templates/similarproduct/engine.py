@@ -34,7 +34,10 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.core.context import ComputeContext
 from predictionio_tpu.data.bimap import BiMap, StringIndexBiMap
 from predictionio_tpu.data.store import PEventStore
-from predictionio_tpu.ops.als import ALSParams, cosine_scores, pad_ratings, train_als
+from predictionio_tpu.parallel.als_sharding import (
+    train_als_auto as _train_als_auto,
+)
+from predictionio_tpu.ops.als import ALSParams, cosine_scores, pad_ratings
 
 
 @dataclasses.dataclass(frozen=True)
@@ -179,7 +182,7 @@ def _train_item_model(ratings: Dict[Tuple[int, int], float],
     params = ALSParams(rank=p.rank, num_iterations=p.num_iterations,
                        lambda_=p.lambda_,
                        seed=0 if p.seed is None else p.seed)
-    _, item_factors = train_als(
+    _, item_factors = _train_als_auto(
         pad_ratings(rows, cols, vals, n_u, n_i),
         pad_ratings(cols, rows, vals, n_i, n_u),
         params)

@@ -70,6 +70,22 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--engine-version", default=None)
 
 
+def _add_distributed_args(p: argparse.ArgumentParser) -> None:
+    """Multi-host topology flags (the spark-submit cluster plane analog,
+    Runner.scala:92-210; see parallel/distributed.py for the launch
+    recipe). Defaults = single-host degenerate case."""
+    p.add_argument("--num-hosts", type=int, default=None,
+                   help="total host processes in the job (default 1; "
+                        "env PIO_NUM_HOSTS)")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator address (host 0); "
+                        "required when --num-hosts > 1 "
+                        "(env PIO_COORDINATOR)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this host's index, 0..num-hosts-1 "
+                        "(env PIO_PROCESS_ID)")
+
+
 def build_parser() -> argparse.ArgumentParser:
     from predictionio_tpu.tools import run_commands
 
@@ -134,6 +150,7 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument("--skip-sanity-check", action="store_true")
     train.add_argument("--stop-after-read", action="store_true")
     train.add_argument("--stop-after-prepare", action="store_true")
+    _add_distributed_args(train)
     train.set_defaults(func=run_commands.cmd_train)
 
     ev = sub.add_parser("eval", help="run an evaluation / tuning sweep")

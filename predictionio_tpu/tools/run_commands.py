@@ -76,6 +76,13 @@ def cmd_train(args) -> int:
     from predictionio_tpu.utils.tracing import profile_trace
 
     try:
+        # multi-host runtime (no-op on one host; parallel/distributed.py)
+        from predictionio_tpu.parallel import distributed
+        dist_cfg = distributed.DistributedConfig.from_args(args)
+        if distributed.initialize(dist_cfg):
+            print(f"[INFO] Joined distributed runtime: host "
+                  f"{distributed.process_index()}/"
+                  f"{distributed.process_count()}")
         variant = _load_variant(args.engine_variant)
         config = _workflow_config(args, variant)
         with profile_trace(getattr(args, "profile_dir", None)):
@@ -87,7 +94,11 @@ def cmd_train(args) -> int:
         print(f"[ERROR] Training failed: {e}", file=sys.stderr)
         return 1
     if instance_id is None:
-        print("[INFO] Training interrupted by a stop-after flag.")
+        if not distributed.is_primary_host():
+            print("[INFO] Secondary host: training complete; persistence "
+                  "done by host 0.")
+        else:
+            print("[INFO] Training interrupted by a stop-after flag.")
         return 0
     print(f"[INFO] Training completed. Engine instance ID: {instance_id}")
     return 0

@@ -78,6 +78,24 @@ def run_train(
     batch = params.batch or engine_instance.batch
     ctx = ctx or workflow_context(mode="train", batch=batch)
 
+    from predictionio_tpu.parallel.distributed import is_primary_host
+    if not is_primary_host():
+        # Secondary hosts of a multi-host job participate in the
+        # collective training program but leave every metadata/model
+        # write to host 0 (the reference's driver persists, executors
+        # don't — CoreWorkflow.scala:74-86 runs in the driver JVM).
+        try:
+            engine.train(ctx, engine_params, engine_instance_id="",
+                         params=params)
+            logger.info("Secondary host: training complete, persistence "
+                        "left to host 0.")
+            return None
+        except TrainingInterruption as e:
+            logger.info("Training interrupted by %r.", e)
+            return None
+        finally:
+            ctx.stop()
+
     engine_instances = storage.get_metadata_engine_instances()
     instance_id = engine_instances.insert(engine_instance)
     instance = engine_instances.get(instance_id)

@@ -1,0 +1,63 @@
+"""Subprocess body for the multi-host test: one of K host processes.
+
+Launched by tests/test_distributed.py with
+``XLA_FLAGS=--xla_force_host_platform_device_count=D`` so each process
+contributes D virtual CPU devices; jax.distributed connects them over a
+localhost coordinator — the real DCN control-plane code path, minus the
+network. Trains the sharded ALS on a fixed tiny problem and prints the
+factor checksum for the parent to compare with the single-process run.
+"""
+
+import json
+import sys
+
+
+def make_problem():
+    """The shared tiny ALS problem — ONE definition for the workers and
+    the parent test's single-process reference, so they can't drift."""
+    import numpy as np
+
+    from predictionio_tpu.ops.als import ALSParams, pad_ratings
+
+    rng = np.random.default_rng(0)
+    n_users, n_items, rank, nnz = 16, 12, 4, 96
+    rows = rng.integers(0, n_users, nnz)
+    cols = rng.integers(0, n_items, nnz)
+    vals = rng.random(nnz).astype(np.float32) + 0.5
+    user_side = pad_ratings(rows, cols, vals, n_users, n_items)
+    item_side = pad_ratings(cols, rows, vals, n_items, n_users)
+    return user_side, item_side, ALSParams(rank=rank, num_iterations=3,
+                                           seed=0)
+
+
+def main() -> None:
+    coordinator, num_hosts, process_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+
+    import numpy as np
+
+    from predictionio_tpu.parallel import distributed
+    from predictionio_tpu.parallel.als_sharding import train_als_sharded
+
+    cfg = distributed.DistributedConfig(
+        coordinator=coordinator, num_hosts=num_hosts, process_id=process_id)
+    assert distributed.initialize(cfg) is True
+    assert distributed.process_count() == num_hosts
+    assert distributed.process_index() == process_id
+
+    user_side, item_side, params = make_problem()
+
+    mesh = distributed.host_aware_mesh()
+    X, Y = train_als_sharded(user_side, item_side, params, mesh)
+    print(json.dumps({
+        "process_id": process_id,
+        "devices": len(mesh.devices.ravel()),
+        "x_sum": float(np.abs(X).sum()),
+        "y_sum": float(np.abs(Y).sum()),
+        "x_row0": [float(v) for v in X[0]],
+    }), flush=True)
+    distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
