@@ -148,6 +148,28 @@ def main() -> None:
     import bench_quality
     quality = bench_quality.run()
 
+    # serving latency: the deployed per-query program (device top-k with
+    # seen masking) at ML-100K scale, AOT-warmed as deploy does
+    from predictionio_tpu.ops.serving import DeviceTopK
+    from predictionio_tpu.utils.tracing import LatencyHistogram
+
+    serve_rng = np.random.default_rng(5)
+    srv = DeviceTopK(
+        np.asarray(X), np.asarray(Y),
+        {u: serve_rng.choice(N_ITEMS, size=20, replace=False)
+         for u in range(N_USERS)})
+    srv.warmup()
+    hist = LatencyHistogram()
+    for uid in serve_rng.integers(0, N_USERS, size=500):
+        t0 = time.perf_counter()
+        srv.user_topk(int(uid), 10)
+        hist.record(time.perf_counter() - t0)
+    s = hist.summary()
+    serving = {"p50_ms": round(s["p50Sec"] * 1000, 3),
+               "p99_ms": round(s["p99Sec"] * 1000, 3),
+               "mean_ms": round(s["meanSec"] * 1000, 3),
+               "queries": s["count"]}
+
     import jax
 
     print(json.dumps({
@@ -168,6 +190,7 @@ def main() -> None:
                 "events_per_sec": round(processed1 / scale_epoch, 1),
             },
             "quality": quality,
+            "serving": serving,
         },
     }))
 

@@ -56,7 +56,8 @@ def _jit_step(mesh, factor_spec):
 
 def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
                    params: ALSParams, mesh, row_divisor: int,
-                   factor_spec, dtype) -> Tuple[np.ndarray, np.ndarray]:
+                   factor_spec, dtype,
+                   gather: bool = True) -> Tuple[np.ndarray, np.ndarray]:
     """Shared sharded-training body: pad rows to ``row_divisor``, shard
     rating tables over 'data', place factors per ``factor_spec``, run the
     full iteration scan, slice padding back off."""
@@ -114,6 +115,10 @@ def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
                 lam=float(params.lambda_), alpha=float(params.alpha),
                 implicit=bool(params.implicit_prefs),
                 num_iterations=int(params.num_iterations))
+    if not gather:
+        # PAlgorithm path: factors STAY sharded in HBM (padded to n_u/n_i
+        # rows); the caller serves from them directly (ops/serving.py)
+        return X, Y
     if multi_host:
         # factors are needed host-side on every host (model persistence,
         # serving); gather across processes over DCN
@@ -161,6 +166,38 @@ def train_als_sharded_2d(user_side: PaddedRatings, item_side: PaddedRatings,
     return _train_sharded(user_side, item_side, params, mesh,
                           row_divisor=mesh.shape["data"] * mesh.shape["model"],
                           factor_spec=P("model", None), dtype=dtype)
+
+
+def train_als_device(user_side: PaddedRatings, item_side: PaddedRatings,
+                     params: ALSParams, mesh=None, dtype=None):
+    """Train and KEEP the factors sharded in HBM — the PAlgorithm flavor
+    (PAlgorithm.scala:44-126: the model lives distributed; nothing is
+    gathered to host).
+
+    Returns ``(X, Y)`` as jax Arrays padded to the mesh divisor — on a
+    2-D mesh they are row-sharded over the 'model' axis (each device
+    stores 1/model of each factor matrix), on a 1-D mesh replicated.
+    Serve them with :class:`predictionio_tpu.ops.serving.DeviceTopK`,
+    passing the true n_users/n_items as the index bounds.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None:
+        from predictionio_tpu.parallel.distributed import host_aware_mesh
+
+        import jax
+
+        n = len(jax.devices())
+        mesh = host_aware_mesh(model=2 if (n % 2 == 0 and n >= 4) else 1)
+    if "model" in mesh.axis_names:
+        divisor = mesh.shape["data"] * mesh.shape["model"]
+        spec = P("model", None)
+    else:
+        divisor = mesh.devices.size
+        spec = P(None, None)
+    return _train_sharded(user_side, item_side, params, mesh,
+                          row_divisor=divisor, factor_spec=spec,
+                          dtype=dtype, gather=False)
 
 
 def train_als_auto(user_side: PaddedRatings, item_side: PaddedRatings,

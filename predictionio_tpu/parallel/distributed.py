@@ -125,11 +125,27 @@ def shutdown() -> None:
 
 def is_primary_host() -> bool:
     """True on the host that owns metadata/model persistence (host 0 —
-    the reference's Spark *driver* role). Deliberately jax-free in the
-    single-process case so storage-only workflows never touch a backend."""
-    if not _INITIALIZED:
+    the reference's Spark *driver* role).
+
+    Also honors a ``jax.distributed.initialize`` done OUTSIDE this module
+    (standard JAX practice): if the distributed client exists, host rank
+    decides. Deliberately jax-free in the plain single-process case so
+    storage-only workflows never touch a backend."""
+    import sys
+
+    if _INITIALIZED:
+        return process_index() == 0
+    jax = sys.modules.get("jax")
+    if jax is None:
         return True
-    return process_index() == 0
+    try:
+        from jax._src import distributed as _jax_dist
+
+        if getattr(_jax_dist.global_state, "client", None) is not None:
+            return jax.process_index() == 0
+    except Exception:  # private-API drift: fall back to primary
+        pass
+    return True
 
 
 def process_count() -> int:

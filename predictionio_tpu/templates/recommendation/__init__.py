@@ -4,27 +4,39 @@
 from predictionio_tpu.templates.recommendation.engine import (
     ALSAlgorithm,
     ALSModel,
+    ALSShardedAlgorithm,
     DataSourceParams,
     EventDataSource,
     ItemScore,
+    PrecisionAtK,
     PredictedResult,
     Query,
     RatingsPreparator,
+    RecommendationEvaluation,
+    RecommendationParamsList,
     RecommendationServing,
+    ShardedALSModel,
     TrainingData,
     engine_factory,
+    sharded_engine_factory,
 )
 
 __all__ = [
     "ALSAlgorithm",
     "ALSModel",
+    "ALSShardedAlgorithm",
     "DataSourceParams",
     "EventDataSource",
     "ItemScore",
+    "PrecisionAtK",
     "PredictedResult",
     "Query",
     "RatingsPreparator",
+    "RecommendationEvaluation",
+    "RecommendationParamsList",
     "RecommendationServing",
+    "ShardedALSModel",
     "TrainingData",
     "engine_factory",
+    "sharded_engine_factory",
 ]

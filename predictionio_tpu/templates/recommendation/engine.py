@@ -30,9 +30,9 @@ from predictionio_tpu.controller import (
     EngineParamsGenerator,
     Evaluation,
     LFirstServing,
-    LServing,
     OptionAverageMetric,
     P2LAlgorithm,
+    PAlgorithm,
     Params,
     PDataSource,
     PPreparator,
@@ -43,10 +43,7 @@ from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.ops.als import (
     ALSParams,
     PaddedRatings,
-    cosine_scores,
     pad_ratings,
-    predict_scores_for_user,
-    top_k_items,
 )
 
 
@@ -233,23 +230,105 @@ class RatingsPreparator(PPreparator):
         return PreparedData(user_map, item_map, user_side, item_side, seen)
 
 
+class _DeviceServedModel:
+    """Shared device-serving plumbing: lazy DeviceTopK construction
+    (``_make_server`` is the per-flavor hook) and pickling that drops
+    the device handles."""
+
+    _server: Any = None
+
+    def device_server(self):
+        if self._server is None:
+            self._server = self._make_server()
+        return self._server
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_server"] = None  # device handles don't pickle
+        return state
+
+
 @dataclasses.dataclass
-class ALSModel:
-    """Host-resident factors + maps (ALSModel.scala analog; automatic
-    persistence — pickles into the Models repo)."""
+class ALSModel(_DeviceServedModel):
+    """Host-persistable factors + maps (ALSModel.scala analog; automatic
+    persistence — pickles into the Models repo). Serving runs on the
+    DEVICE: ``device_server()`` places the factors in HBM behind an
+    AOT-compiled top-k program (ops/serving.py); the pickled blob never
+    contains device state."""
 
     user_factors: np.ndarray     # [N, R]
     item_factors: np.ndarray     # [M, R]
     user_map: StringIndexBiMap
     item_map: StringIndexBiMap
     seen: Dict[int, np.ndarray]
+    _server: Any = dataclasses.field(default=None, repr=False, compare=False)
+
+    def _make_server(self):
+        from predictionio_tpu.ops.serving import DeviceTopK
+
+        return DeviceTopK(self.user_factors, self.item_factors, self.seen)
 
     def sanity_check(self) -> None:
         assert np.isfinite(self.user_factors).all(), "non-finite user factors"
         assert np.isfinite(self.item_factors).all(), "non-finite item factors"
 
 
-class ALSAlgorithm(P2LAlgorithm):
+def _coerce_query(query: Any) -> Query:
+    """Raw JSON query from the server -> typed Query."""
+    if isinstance(query, dict):
+        return Query(user=query.get("user"),
+                     items=tuple(query.get("items", ())),
+                     num=int(query.get("num", 10)),
+                     blacklist=tuple(query.get("blacklist", ())))
+    return query
+
+
+def _serve_topk(server, user_map: StringIndexBiMap,
+                item_map: StringIndexBiMap, query: Query) -> PredictedResult:
+    """Shared device-serving logic for both ALS flavors: ask the compiled
+    program for num + |blacklist| winners (seen items already masked on
+    device), drop blacklisted/non-positive ones host-side, clip to num."""
+    black = {item_map[i] for i in query.blacklist if i in item_map}
+    k = query.num + len(black)
+    if query.items:
+        idxs = [item_map[i] for i in query.items if i in item_map]
+        if not idxs:
+            return PredictedResult(())
+        idx, scores = server.items_topk(idxs, k)
+    elif query.user is not None:
+        uidx = user_map.get(query.user)
+        if uidx is None:
+            return PredictedResult(())
+        idx, scores = server.user_topk(uidx, k)
+    else:
+        return PredictedResult(())
+    keep = [(i, s) for i, s in zip(idx.tolist(), scores.tolist())
+            if i not in black and s > 0][:query.num]
+    if not keep:
+        return PredictedResult(())
+    items = item_map.decode(np.asarray([i for i, _ in keep],
+                                       dtype=np.int64))
+    return PredictedResult(tuple(
+        ItemScore(item=item, score=s)
+        for item, (_, s) in zip(items, keep)))
+
+
+class _DeviceServingAlgo:
+    """Shared predict/warmup for every ALS flavor served by DeviceTopK."""
+
+    def warmup_base(self, model) -> None:
+        """Compile the device top-k buckets at deploy so the first real
+        query pays no compile/first-dispatch cost (SURVEY hard part #4)."""
+        if len(model.user_map):
+            model.device_server().warmup()
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        query = _coerce_query(query)
+        return _serve_topk(model.device_server(), model.user_map,
+                           model.item_map, query)
+
+
+class ALSAlgorithm(_DeviceServingAlgo, P2LAlgorithm):
     """Implicit ALS on the TPU mesh (ALSAlgorithm.scala:64-103 parity)."""
 
     params_class = ALSParams
@@ -263,62 +342,66 @@ class ALSAlgorithm(P2LAlgorithm):
         X, Y = train_als_auto(pd.user_side, pd.item_side, self.params)
         return ALSModel(X, Y, pd.user_map, pd.item_map, pd.seen)
 
-    def warmup_base(self, model: ALSModel) -> None:
-        """Run one predict at deploy so the first real query pays no
-        compile/first-dispatch cost (SURVEY hard part #4)."""
-        if len(model.user_map):
-            user = str(model.user_map.decode(np.asarray([0]))[0])
-            self.predict(model, Query(user=user, num=1))
 
-    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
-        if isinstance(query, dict):  # raw JSON query from the server
-            query = Query(user=query.get("user"),
-                          items=tuple(query.get("items", ())),
-                          num=int(query.get("num", 10)),
-                          blacklist=tuple(query.get("blacklist", ())))
-        if query.items:
-            scores = self._item_similarity_scores(model, query)
-        elif query.user is not None:
-            scores = self._user_scores(model, query)
-        else:
-            return PredictedResult(())
-        if scores is None:
-            return PredictedResult(())
-        # blacklist + seen filtering
-        black = [model.item_map[i] for i in query.blacklist
-                 if i in model.item_map]
-        if black:
-            scores[np.asarray(black, dtype=np.int64)] = -np.inf
-        idx, top = top_k_items(scores, query.num)
-        keep = np.isfinite(top) & (top > 0)
-        items = model.item_map.decode(idx[keep])
-        return PredictedResult(tuple(
-            ItemScore(item=i, score=float(s))
-            for i, s in zip(items, top[keep])))
+@dataclasses.dataclass
+class ShardedALSModel(_DeviceServedModel):
+    """Device-RESIDENT model: factor matrices live sharded in HBM
+    (padded jax Arrays from ``train_als_device``) and are never gathered
+    to host — the PAlgorithm 'model bigger than a host' semantics
+    (PAlgorithm.scala:24-45, SURVEY hard part #5). Not picklable by
+    design; persistence mode is RETRAIN-at-deploy."""
 
-    def _user_scores(self, model: ALSModel,
-                     query: Query) -> Optional[np.ndarray]:
-        uidx = model.user_map.get(query.user)
-        if uidx is None:
-            return None
-        scores = predict_scores_for_user(
-            model.user_factors[uidx], model.item_factors)
-        seen = model.seen.get(uidx)
-        if seen is not None and len(seen):
-            scores = scores.copy()
-            scores[seen] = -np.inf  # never recommend already-rated items
-        return scores
+    user_factors: Any            # jax Array [N_pad, R], sharded
+    item_factors: Any            # jax Array [M_pad, R], sharded
+    n_users: int
+    n_items: int
+    user_map: StringIndexBiMap
+    item_map: StringIndexBiMap
+    seen: Dict[int, np.ndarray]
+    _server: Any = dataclasses.field(default=None, repr=False, compare=False)
 
-    def _item_similarity_scores(self, model: ALSModel,
-                                query: Query) -> Optional[np.ndarray]:
-        idxs = [model.item_map[i] for i in query.items
-                if i in model.item_map]
-        if not idxs:
-            return None
-        qf = model.item_factors[np.asarray(idxs, dtype=np.int64)]
-        scores = cosine_scores(qf, model.item_factors)
-        scores[np.asarray(idxs, dtype=np.int64)] = -np.inf  # not the query
-        return scores
+    def _make_server(self):
+        from predictionio_tpu.ops.serving import DeviceTopK
+
+        return DeviceTopK(
+            self.user_factors, self.item_factors, self.seen,
+            n_users=self.n_users, n_items=self.n_items)
+
+    def sanity_check(self) -> None:
+        # finiteness check WITHOUT gathering the factors: reduce on device
+        import jax.numpy as jnp
+
+        assert bool(jnp.isfinite(self.user_factors).all()), \
+            "non-finite user factors"
+        assert bool(jnp.isfinite(self.item_factors).all()), \
+            "non-finite item factors"
+
+
+class ALSShardedAlgorithm(_DeviceServingAlgo, PAlgorithm):
+    """PAlgorithm flavor of the ALS template: trains with
+    ``train_als_device`` and serves straight from the HBM shards through
+    the compiled top-k program — no host copy of the factors exists at
+    any point (the reference's RDD-model ALS variant,
+    ``examples/scala-parallel-recommendation/custom-query/.../
+    ALSAlgorithm.scala:77-103``, where predict runs cluster-side)."""
+
+    params_class = ALSParams
+    query_cls = Query
+
+    def train(self, ctx: ComputeContext,
+              pd: PreparedData) -> ShardedALSModel:
+        from predictionio_tpu.parallel.als_sharding import train_als_device
+
+        X, Y = train_als_device(pd.user_side, pd.item_side, self.params)
+        return ShardedALSModel(
+            X, Y, pd.user_side.n_rows, pd.user_side.n_cols,
+            pd.user_map, pd.item_map, pd.seen)
+
+    def batch_predict(self, ctx: ComputeContext, model: ShardedALSModel,
+                      indexed_queries) -> List[Tuple[int, Any]]:
+        """Evaluation over the device-resident model: each query is one
+        device dispatch against the compiled bucket programs."""
+        return [(qx, self.predict(model, q)) for qx, q in indexed_queries]
 
 
 class RecommendationServing(LFirstServing):
@@ -390,5 +473,16 @@ def engine_factory() -> Engine:
         EventDataSource,
         RatingsPreparator,
         {"als": ALSAlgorithm, "": ALSAlgorithm},
+        RecommendationServing,
+    )
+
+
+def sharded_engine_factory() -> Engine:
+    """Engine whose model stays sharded in HBM (PAlgorithm flavor) —
+    deploy retrains (persistence mode 3) and serves from the device."""
+    return Engine(
+        EventDataSource,
+        RatingsPreparator,
+        {"als": ALSShardedAlgorithm, "": ALSShardedAlgorithm},
         RecommendationServing,
     )
