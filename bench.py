@@ -113,6 +113,141 @@ def timed_training(user_side, item_side, params, repeats: int = 3):
     return best, result
 
 
+def serving_bench(X: np.ndarray, Y: np.ndarray, n_queries: int = 300,
+                  batch: int = 256) -> dict:
+    """Serving latency with the transport/execution split the published
+    number needs (round-3 verdict: the TPU in this harness sits behind a
+    network tunnel, so host↔device RTT dominates single-query latency and
+    must not masquerade as compute). Reports, all from RAW samples (exact
+    percentiles, no histogram buckets):
+
+    - single_query: end-to-end per-query wall time (exactly ONE blocking
+      device→host fetch per query after the serving.py packing fix)
+    - transport_rtt_ms: the cost of fetching one fresh 4-byte result —
+      the floor any per-query device serving pays on this link
+    - device_exec_us: pure program time measured by looping the query
+      program on device inside one dispatch (the number that matters
+      when queries are batched or the device is local over PCIe);
+      pipelined_dispatch_us adds the per-dispatch host overhead
+    - batched: `users_topk` over a uid batch — one RTT amortized over
+      `batch` queries (P2LAlgorithm.scala:66-68 batch semantics)
+    - host_serving: the path `choose_server` actually deploys for a
+      host-resident model of this size — HostTopK, the reference's
+      in-JVM predict shape (CreateServer.scala:533-540) with zero
+      device hops
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops.serving import DeviceTopK
+
+    n_users, n_items = X.shape[0], Y.shape[0]
+    serve_rng = np.random.default_rng(5)
+    seen = {u: serve_rng.choice(n_items, size=20, replace=False)
+            for u in range(n_users)}
+    srv = DeviceTopK(X, Y, seen)
+    srv.warmup(batch_sizes=(batch,))
+
+    def pcts(samples_ms):
+        a = np.asarray(samples_ms)
+        return {"p50_ms": round(float(np.percentile(a, 50)), 3),
+                "p99_ms": round(float(np.percentile(a, 99)), 3),
+                "mean_ms": round(float(a.mean()), 3),
+                "queries": int(a.size)}
+
+    uids = serve_rng.integers(0, n_users, size=n_queries)
+    single = []
+    for uid in uids:
+        t0 = time.perf_counter()
+        srv.user_topk(int(uid), 10)
+        single.append((time.perf_counter() - t0) * 1e3)
+
+    # transport floor: dispatch a trivial program and fetch its fresh
+    # 4-byte result (a cached host copy would measure nothing)
+    tiny = jnp.zeros((), jnp.float32)
+    bump = jax.jit(lambda x: x + 1.0)
+    np.asarray(bump(tiny))  # warm
+    rtt = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        np.asarray(bump(tiny))
+        rtt.append((time.perf_counter() - t0) * 1e3)
+
+    # device execution: run the query program N times inside ONE on-device
+    # fori_loop dispatch (uid varies per step so nothing CSEs away) — pure
+    # program time, no per-dispatch host/tunnel overhead
+    from functools import partial as _partial
+
+    from predictionio_tpu.ops.serving import _user_topk
+
+    LOOP_N = 1000
+    step = _partial(_user_topk, k=16, mask_seen=True, n_items=n_items)
+
+    @jax.jit
+    def loop_exec(X_, Y_, sc, sm):
+        def body(i, acc):
+            return acc + step(X_, Y_, sc, sm, i % n_users)[0]
+        return jax.lax.fori_loop(0, LOOP_N, body, jnp.float32(0))
+
+    args = (srv._X, srv._Y, srv._seen_cols, srv._seen_mask)
+    loop_exec(*args).block_until_ready()  # warm
+    t0 = time.perf_counter()
+    loop_exec(*args).block_until_ready()
+    exec_us = (time.perf_counter() - t0) / LOOP_N * 1e6
+
+    # per-dispatch cost when M dispatches are pipelined (one final block):
+    # what a busy single-query server pays per query host-side
+    prog = srv._user_program(16)
+    prog(*args, np.int32(0)).block_until_ready()
+    M = 200
+    t0 = time.perf_counter()
+    out = None
+    for i in range(M):
+        out = prog(*args, np.int32(i % n_users))
+    out.block_until_ready()
+    dispatch_us = (time.perf_counter() - t0) / M * 1e6
+
+    # batched: one dispatch + one packed fetch per `batch` queries
+    buids = serve_rng.integers(0, n_users, size=batch)
+    srv.users_topk(buids, 10)  # warm this exact bucket
+    batch_ms = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        srv.users_topk(buids, 10)
+        batch_ms.append((time.perf_counter() - t0) * 1e3)
+    best_batch_ms = min(batch_ms)
+
+    # host serving: what `choose_server` actually deploys for a
+    # host-resident model of this size (HostTopK, zero device hops)
+    from predictionio_tpu.ops.serving import choose_server
+
+    hsrv = choose_server(X, Y, seen)
+    hsrv.user_topk(0, 10)  # touch caches
+    host = []
+    for uid in uids[:100]:
+        t0 = time.perf_counter()
+        hsrv.user_topk(int(uid), 10)
+        host.append((time.perf_counter() - t0) * 1e3)
+
+    return {
+        "single_query": pcts(single),
+        "transport_rtt_ms": round(float(np.median(rtt)), 3),
+        "device_exec_us": round(exec_us, 1),
+        "pipelined_dispatch_us": round(dispatch_us, 1),
+        "batched": {
+            "batch": batch,
+            "ms_per_batch": round(best_batch_ms, 3),
+            "us_per_query": round(best_batch_ms / batch * 1e3, 2),
+            "queries_per_sec": round(batch / (best_batch_ms / 1e3), 1),
+        },
+        "host_serving": {**pcts(host), "backend": type(hsrv).__name__},
+        "note": ("single-query latency = transport RTT + device exec; "
+                 "on a tunneled device the RTT dominates — choose_server "
+                 "deploys HostTopK for host-resident models this small, "
+                 "DeviceTopK (batched) for big/sharded ones"),
+    }
+
+
 def main() -> None:
     from predictionio_tpu.ops.als import ALSParams
 
@@ -148,27 +283,7 @@ def main() -> None:
     import bench_quality
     quality = bench_quality.run()
 
-    # serving latency: the deployed per-query program (device top-k with
-    # seen masking) at ML-100K scale, AOT-warmed as deploy does
-    from predictionio_tpu.ops.serving import DeviceTopK
-    from predictionio_tpu.utils.tracing import LatencyHistogram
-
-    serve_rng = np.random.default_rng(5)
-    srv = DeviceTopK(
-        np.asarray(X), np.asarray(Y),
-        {u: serve_rng.choice(N_ITEMS, size=20, replace=False)
-         for u in range(N_USERS)})
-    srv.warmup()
-    hist = LatencyHistogram()
-    for uid in serve_rng.integers(0, N_USERS, size=500):
-        t0 = time.perf_counter()
-        srv.user_topk(int(uid), 10)
-        hist.record(time.perf_counter() - t0)
-    s = hist.summary()
-    serving = {"p50_ms": round(s["p50Sec"] * 1000, 3),
-               "p99_ms": round(s["p99Sec"] * 1000, 3),
-               "mean_ms": round(s["meanSec"] * 1000, 3),
-               "queries": s["count"]}
+    serving = serving_bench(np.asarray(X), np.asarray(Y))
 
     import jax
 

@@ -19,6 +19,18 @@ with an AOT-compiled gather→matmul→top_k program:
   model: XLA partitions the matmul and merges per-shard top-k — no host
   gather of the factors ever happens (hard part #5, PAlgorithm
   semantics).
+
+Transport discipline (the reference serves from in-JVM memory with zero
+device hops, `CreateServer.scala:533-540` — so every host↔device round
+trip here is pure regression and is treated as such):
+
+- each program packs (scores, bitcast(indices)) into ONE flat float32
+  output, so a query pays exactly one blocking device→host fetch; the
+  uid travels inside the jit dispatch (no separate transfer op).
+- `users_topk` vmaps the same program over a padded uid bucket: B
+  concurrent queries cost the SAME single round trip (the reference's
+  batch path is likewise one cluster job over the whole query set,
+  `P2LAlgorithm.scala:66-68`).
 """
 
 from __future__ import annotations
@@ -56,9 +68,26 @@ def _mask_padding(scores, n_items: int):
     return scores
 
 
+def _pack(scores, idx):
+    """Fuse (scores [.., k] f32, idx [.., k] i32) into ONE [.., 2k] f32
+    buffer (indices bitcast, not value-cast — exact at any size) so the
+    host pays a single device→host fetch per dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.concatenate(
+        [scores, jax.lax.bitcast_convert_type(idx, jnp.float32)], axis=-1)
+
+
+def _unpack(out: np.ndarray, kb: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side inverse of `_pack` on the fetched numpy buffer."""
+    return out[..., kb:].view(np.int32), out[..., :kb]
+
+
 def _user_topk(X, Y, seen_cols, seen_mask, uid, *, k: int, mask_seen: bool,
                n_items: int):
-    """scores = Y @ X[uid], seen + padding masked to -inf, device top_k."""
+    """scores = Y @ X[uid], seen + padding masked to -inf, device top_k,
+    packed into one flat output buffer."""
     import jax
     import jax.numpy as jnp
 
@@ -71,7 +100,7 @@ def _user_topk(X, Y, seen_cols, seen_mask, uid, *, k: int, mask_seen: bool,
         # pad slots carry mask 0 -> add 0.0 to item 0; real slots -inf
         scores = scores.at[sc].add(
             jnp.where(sm > 0, -jnp.inf, 0.0), mode="drop")
-    return jax.lax.top_k(_mask_padding(scores, n_items), k)
+    return _pack(*jax.lax.top_k(_mask_padding(scores, n_items), k))
 
 
 def _items_topk(Yn, idx, idx_mask, *, k: int, n_items: int):
@@ -88,7 +117,7 @@ def _items_topk(Yn, idx, idx_mask, *, k: int, n_items: int):
     # the query items themselves never recommend (mask to -inf)
     scores = scores.at[idx].add(
         jnp.where(idx_mask > 0, -jnp.inf, 0.0), mode="drop")
-    return jax.lax.top_k(_mask_padding(scores, n_items), k)
+    return _pack(*jax.lax.top_k(_mask_padding(scores, n_items), k))
 
 
 def _normalize_rows(Y):
@@ -108,6 +137,114 @@ def _bucket(n: int, lo: int = 16) -> int:
     while b < n:
         b *= 2
     return b
+
+
+class HostTopK:
+    """Host-memory top-N server with the same interface as
+    :class:`DeviceTopK` — numpy scoring + argpartition, zero device round
+    trips. This is the reference's own serving shape (in-JVM predict from
+    host objects, `CreateServer.scala:533-540`): for models that fit in
+    host RAM the per-query matvec is microseconds, which beats any
+    host↔device transport. The deploy path picks it automatically for
+    small host-resident factors (see `choose_server`); device-resident /
+    sharded models always serve via DeviceTopK."""
+
+    def __init__(self, user_factors: np.ndarray, item_factors: np.ndarray,
+                 seen: Optional[Dict[int, np.ndarray]] = None,
+                 n_users: Optional[int] = None,
+                 n_items: Optional[int] = None):
+        self._X = np.asarray(user_factors)
+        self._Y = np.asarray(item_factors)
+        self.n_users = int(n_users if n_users is not None
+                           else self._X.shape[0])
+        self.n_items = int(n_items if n_items is not None
+                           else self._Y.shape[0])
+        self._seen = seen or {}
+        self._Yn: Optional[np.ndarray] = None
+
+    def warmup(self, max_k: int = 128, batch_sizes: Tuple[int, ...] = ()) \
+            -> None:
+        """Nothing to compile host-side."""
+
+    def _topk_row(self, scores: np.ndarray, k: int):
+        k = min(k, scores.shape[0])
+        top = np.argpartition(-scores, k - 1)[:k]
+        top = top[np.argsort(-scores[top], kind="stable")]
+        s = scores[top]
+        valid = np.isfinite(s)
+        return top[valid].astype(np.int32), s[valid]
+
+    def _user_scores(self, uid: int) -> np.ndarray:
+        scores = self._Y[:self.n_items] @ self._X[uid]
+        s = self._seen.get(uid)
+        if s is not None and len(s):
+            scores[s[s < self.n_items]] = -np.inf
+        return scores
+
+    def user_topk(self, uid: int, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        return self._topk_row(self._user_scores(uid), k)
+
+    def users_topk(self, uids, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        uids = np.asarray(uids, dtype=np.int64)
+        k = min(k, self.n_items)
+        idx = np.zeros((len(uids), k), dtype=np.int32)
+        scores = np.full((len(uids), k), -np.inf, dtype=np.float32)
+        for row, uid in enumerate(uids):
+            i, s = self._topk_row(self._user_scores(int(uid)), k)
+            idx[row, :len(i)] = i
+            scores[row, :len(s)] = s
+        return idx, scores
+
+    def items_topk(self, idxs, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        if self._Yn is None:
+            Y = self._Y[:self.n_items].astype(np.float32)
+            norms = np.maximum(np.linalg.norm(Y, axis=1, keepdims=True),
+                               1e-12)
+            self._Yn = Y / norms
+        idxs = np.asarray(idxs, dtype=np.int64)
+        scores = self._Yn @ self._Yn[idxs].sum(axis=0)
+        scores[idxs] = -np.inf
+        return self._topk_row(scores, k)
+
+
+# Above this many item-factor elements the score matrix stops being a
+# host-trivial matvec and the MXU path wins even with transport.
+HOST_SERVE_MAX_ELEMS = 1 << 22
+
+
+def choose_server(user_factors, item_factors,
+                  seen: Optional[Dict[int, np.ndarray]] = None,
+                  n_users: Optional[int] = None,
+                  n_items: Optional[int] = None):
+    """Serving-backend policy for host-persistable models (P2L flavors):
+
+    - ``PIO_SERVING_BACKEND=host``   -> HostTopK always
+    - ``PIO_SERVING_BACKEND=device`` -> DeviceTopK always
+    - auto (default): HostTopK when the factors are host arrays small
+      enough that a numpy matvec beats a device round trip
+      (< HOST_SERVE_MAX_ELEMS item-factor elements); DeviceTopK otherwise.
+
+    Device-resident (sharded) models never go through this — their
+    factors live only in HBM and always serve via DeviceTopK."""
+    import os
+
+    backend = os.environ.get("PIO_SERVING_BACKEND", "auto").lower()
+    host_capable = not (hasattr(user_factors, "sharding")
+                        or hasattr(item_factors, "sharding"))
+    if backend == "host":
+        if not host_capable:
+            raise ValueError(
+                "PIO_SERVING_BACKEND=host but the factors are "
+                "device-resident jax Arrays")
+        cls = HostTopK
+    elif backend == "device":
+        cls = DeviceTopK
+    else:
+        small = (np.asarray(item_factors).size <= HOST_SERVE_MAX_ELEMS
+                 if host_capable else False)
+        cls = HostTopK if host_capable and small else DeviceTopK
+    return cls(user_factors, item_factors, seen,
+               n_users=n_users, n_items=n_items)
 
 
 class DeviceTopK:
@@ -146,6 +283,7 @@ class DeviceTopK:
         self._seen_cols = self._replicate_like_factors(jnp.asarray(cols))
         self._seen_mask = self._replicate_like_factors(jnp.asarray(mask))
         self._user_programs: Dict[int, object] = {}
+        self._batch_programs: Dict[Tuple[int, int], object] = {}
         self._item_programs: Dict[object, object] = {}
         self._Yn = None  # normalized item matrix, built on first item query
 
@@ -174,6 +312,20 @@ class DeviceTopK:
             self._user_programs[k] = prog
         return prog
 
+    def _batch_program(self, k: int, b: int):
+        """vmap of the per-user program over a [b] uid vector: b queries,
+        one dispatch, one packed [b, 2k] fetch."""
+        import jax
+
+        prog = self._batch_programs.get((k, b))
+        if prog is None:
+            prog = jax.jit(jax.vmap(
+                partial(_user_topk, k=k, mask_seen=self._mask_seen,
+                        n_items=self.n_items),
+                in_axes=(None, None, None, None, 0)))
+            self._batch_programs[(k, b)] = prog
+        return prog
+
     def _normalized_items(self):
         """Row-normalized item matrix for similarity queries, computed
         once on first use (one extra HBM buffer, saves O(M*R) per query)."""
@@ -181,13 +333,18 @@ class DeviceTopK:
             self._Yn = _normalize_rows(self._Y)
         return self._Yn
 
-    def warmup(self, max_k: int = 128) -> None:
+    def warmup(self, max_k: int = 128, batch_sizes: Tuple[int, ...] = ()) \
+            -> None:
         """Compile + run EVERY bucket program up to ``max_k`` (deploy-time
         AOT so no live query in that range ever pays a compile — SURVEY
-        hard part #4)."""
+        hard part #4). ``batch_sizes`` additionally warms the batched
+        multi-query programs at those uid-bucket sizes."""
         k = 16
         while True:
             self.user_topk(0, min(k, self.n_items))
+            for b in batch_sizes:
+                self.users_topk(np.zeros(b, dtype=np.int64),
+                                min(k, self.n_items))
             if k >= max_k or k >= self.n_items:
                 break
             k *= 2
@@ -198,16 +355,38 @@ class DeviceTopK:
     def user_topk(self, uid: int, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """(item indices, scores) for one user, descending; seen items are
         masked on device. k is rounded up to the compiled bucket and the
-        result clipped, so arbitrary nums reuse programs."""
-        import jax.numpy as jnp
-
+        result clipped, so arbitrary nums reuse programs. Costs exactly
+        one blocking device→host round trip (the packed fetch); the uid
+        rides inside the async jit dispatch."""
         kb = min(_bucket(k), self.n_items)
-        scores, idx = self._user_program(kb)(
+        out = self._user_program(kb)(
             self._X, self._Y, self._seen_cols, self._seen_mask,
-            jnp.int32(uid))
-        idx, scores = np.asarray(idx)[:k], np.asarray(scores)[:k]
+            np.int32(uid))
+        idx, scores = _unpack(np.asarray(out), kb)
+        idx, scores = idx[:k], scores[:k]
         valid = np.isfinite(scores)
         return idx[valid], scores[valid]
+
+    def users_topk(self, uids, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched top-k for a vector of user indices: ONE device dispatch
+        and ONE packed fetch for the whole batch (P2LAlgorithm.scala:66-68
+        batch-predict-as-one-job semantics). The batch is padded to a
+        power-of-two uid bucket so arbitrary sizes reuse a handful of
+        compiled programs.
+
+        Returns ``(idx [B, kb] int32, scores [B, kb] float32)`` rows
+        descending; rows may contain -inf scores past the valid
+        candidates (callers filter per row, as `user_topk` does)."""
+        uids = np.asarray(uids, dtype=np.int32)
+        n = len(uids)
+        bb = _bucket(max(n, 1), lo=8)
+        padded = np.zeros(bb, dtype=np.int32)
+        padded[:n] = uids
+        kb = min(_bucket(k), self.n_items)
+        out = self._batch_program(kb, bb)(
+            self._X, self._Y, self._seen_cols, self._seen_mask, padded)
+        idx, scores = _unpack(np.asarray(out), kb)
+        return idx[:n, :k], scores[:n, :k]
 
     def items_topk(self, idxs, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """Item-similarity top-k for a list of query item indices."""
@@ -228,8 +407,9 @@ class DeviceTopK:
             prog = jax.jit(partial(_items_topk, k=kb,
                                    n_items=self.n_items))
             self._item_programs[(kb, B)] = prog
-        scores, idx = prog(self._normalized_items(), jnp.asarray(pad_idx),
-                           jnp.asarray(pad_mask))
-        idx, scores = np.asarray(idx)[:k], np.asarray(scores)[:k]
+        out = prog(self._normalized_items(), jnp.asarray(pad_idx),
+                   jnp.asarray(pad_mask))
+        idx, scores = _unpack(np.asarray(out), kb)
+        idx, scores = idx[:k], scores[:k]
         valid = np.isfinite(scores)
         return idx[valid], scores[valid]

@@ -264,9 +264,13 @@ class ALSModel(_DeviceServedModel):
     _server: Any = dataclasses.field(default=None, repr=False, compare=False)
 
     def _make_server(self):
-        from predictionio_tpu.ops.serving import DeviceTopK
+        # backend policy: host numpy for small host-resident factors
+        # (beats any host<->device transport, the reference's in-JVM
+        # predict shape), device program otherwise; override with
+        # PIO_SERVING_BACKEND=host|device
+        from predictionio_tpu.ops.serving import choose_server
 
-        return DeviceTopK(self.user_factors, self.item_factors, self.seen)
+        return choose_server(self.user_factors, self.item_factors, self.seen)
 
     def sanity_check(self) -> None:
         assert np.isfinite(self.user_factors).all(), "non-finite user factors"
@@ -281,6 +285,21 @@ def _coerce_query(query: Any) -> Query:
                      num=int(query.get("num", 10)),
                      blacklist=tuple(query.get("blacklist", ())))
     return query
+
+
+def _winners_to_result(idx, scores, black, num: int,
+                       item_map: StringIndexBiMap) -> PredictedResult:
+    """Fetched top-k row -> PredictedResult: drop blacklisted, non-finite
+    and non-positive scores host-side, clip to num."""
+    keep = [(i, s) for i, s in zip(idx.tolist(), scores.tolist())
+            if i not in black and np.isfinite(s) and s > 0][:num]
+    if not keep:
+        return PredictedResult(())
+    items = item_map.decode(np.asarray([i for i, _ in keep],
+                                       dtype=np.int64))
+    return PredictedResult(tuple(
+        ItemScore(item=item, score=s)
+        for item, (_, s) in zip(items, keep)))
 
 
 def _serve_topk(server, user_map: StringIndexBiMap,
@@ -302,15 +321,7 @@ def _serve_topk(server, user_map: StringIndexBiMap,
         idx, scores = server.user_topk(uidx, k)
     else:
         return PredictedResult(())
-    keep = [(i, s) for i, s in zip(idx.tolist(), scores.tolist())
-            if i not in black and s > 0][:query.num]
-    if not keep:
-        return PredictedResult(())
-    items = item_map.decode(np.asarray([i for i, _ in keep],
-                                       dtype=np.int64))
-    return PredictedResult(tuple(
-        ItemScore(item=item, score=s)
-        for item, (_, s) in zip(items, keep)))
+    return _winners_to_result(idx, scores, black, query.num, item_map)
 
 
 class _DeviceServingAlgo:
@@ -327,6 +338,36 @@ class _DeviceServingAlgo:
         return _serve_topk(model.device_server(), model.user_map,
                            model.item_map, query)
 
+    def _batched_predict(self, model, indexed_queries
+                         ) -> List[Tuple[int, Any]]:
+        """Batch-predict as ONE device job (P2LAlgorithm.scala:66-68):
+        known-user queries are grouped per (num + blacklist) bucket and
+        dispatched through `DeviceTopK.users_topk` — one round trip per
+        group instead of one per query; item-similarity / unknown-user
+        queries fall back to the per-query path."""
+        queries = [(qx, _coerce_query(q)) for qx, q in indexed_queries]
+        server = model.device_server()
+        results: Dict[int, Any] = {}
+        # (k needed) -> list of (qx, uidx, blacklist idx set, num)
+        groups: Dict[int, List[Tuple[int, int, set, int]]] = {}
+        for qx, q in queries:
+            uidx = (model.user_map.get(q.user)
+                    if q.user is not None and not q.items else None)
+            if uidx is None:
+                results[qx] = self.predict(model, q)
+                continue
+            black = {model.item_map[i] for i in q.blacklist
+                     if i in model.item_map}
+            k = q.num + len(black)
+            groups.setdefault(k, []).append((qx, uidx, black, q.num))
+        for k, rows in groups.items():
+            uids = np.asarray([r[1] for r in rows], dtype=np.int64)
+            idx, scores = server.users_topk(uids, k)
+            for row, (qx, _, black, num) in enumerate(rows):
+                results[qx] = _winners_to_result(
+                    idx[row], scores[row], black, num, model.item_map)
+        return [(qx, results[qx]) for qx, _ in queries]
+
 
 class ALSAlgorithm(_DeviceServingAlgo, P2LAlgorithm):
     """Implicit ALS on the TPU mesh (ALSAlgorithm.scala:64-103 parity)."""
@@ -341,6 +382,10 @@ class ALSAlgorithm(_DeviceServingAlgo, P2LAlgorithm):
 
         X, Y = train_als_auto(pd.user_side, pd.item_side, self.params)
         return ALSModel(X, Y, pd.user_map, pd.item_map, pd.seen)
+
+    def batch_predict(self, ctx: ComputeContext, model: "ALSModel",
+                      indexed_queries) -> List[Tuple[int, Any]]:
+        return self._batched_predict(model, indexed_queries)
 
 
 @dataclasses.dataclass
@@ -399,9 +444,10 @@ class ALSShardedAlgorithm(_DeviceServingAlgo, PAlgorithm):
 
     def batch_predict(self, ctx: ComputeContext, model: ShardedALSModel,
                       indexed_queries) -> List[Tuple[int, Any]]:
-        """Evaluation over the device-resident model: each query is one
-        device dispatch against the compiled bucket programs."""
-        return [(qx, self.predict(model, q)) for qx, q in indexed_queries]
+        """Evaluation over the device-resident model: the whole query set
+        runs as grouped `users_topk` dispatches against the HBM shards —
+        one round trip per group, not per query."""
+        return self._batched_predict(model, indexed_queries)
 
 
 class RecommendationServing(LFirstServing):

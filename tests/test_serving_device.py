@@ -117,6 +117,30 @@ class TestDeviceTopK:
         np.testing.assert_allclose(scores, oscores[:len(scores)], rtol=1e-4)
         assert set(idx.tolist()) <= set(oidx.tolist())
 
+    def test_users_topk_matches_single_query_path(self, factors):
+        """The batched program (one dispatch, one packed fetch) returns
+        exactly what N single-query dispatches would."""
+        X, Y, seen = factors
+        srv = DeviceTopK(X, Y, seen)
+        uids = np.asarray([0, 3, 7, 12, 19])
+        idx_b, scores_b = srv.users_topk(uids, 5)
+        assert idx_b.shape == (5, 5) and scores_b.shape == (5, 5)
+        for row, uid in enumerate(uids):
+            idx1, scores1 = srv.user_topk(int(uid), 5)
+            valid = np.isfinite(scores_b[row])
+            np.testing.assert_allclose(scores_b[row][valid], scores1,
+                                       rtol=1e-5)
+            assert idx_b[row][valid].tolist() == idx1.tolist()
+
+    def test_users_topk_bucket_reuse(self, factors):
+        X, Y, seen = factors
+        srv = DeviceTopK(X, Y, seen)
+        srv.users_topk([0, 1, 2], 5)       # uid bucket 8, k bucket 16
+        srv.users_topk(np.arange(7), 10)   # same buckets
+        assert len(srv._batch_programs) == 1
+        srv.users_topk(np.arange(9), 5)    # uid bucket 16
+        assert len(srv._batch_programs) == 2
+
     def test_seen_tables_packing(self):
         cols, mask = seen_tables({0: np.asarray([3, 1]),
                                   2: np.asarray([7])}, 4)
@@ -124,6 +148,77 @@ class TestDeviceTopK:
         assert set(cols[0][mask[0] > 0].tolist()) == {3, 1}
         assert mask[1].sum() == 0
         assert cols[2][0] == 7 and mask[2].sum() == 1
+
+
+class TestHostTopK:
+    """HostTopK must be observably interchangeable with DeviceTopK —
+    `choose_server` swaps them by model size/placement."""
+
+    @pytest.fixture(scope="class")
+    def factors(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(20, 6)).astype(np.float32)
+        Y = rng.normal(size=(33, 6)).astype(np.float32)
+        seen = {u: rng.choice(33, size=rng.integers(1, 6), replace=False)
+                for u in range(0, 20, 2)}
+        return X, Y, seen
+
+    def test_matches_device_server(self, factors):
+        from predictionio_tpu.ops.serving import HostTopK
+
+        X, Y, seen = factors
+        hsrv, dsrv = HostTopK(X, Y, seen), DeviceTopK(X, Y, seen)
+        for uid in (0, 1, 7, 19):
+            hi, hs = hsrv.user_topk(uid, 5)
+            di, ds = dsrv.user_topk(uid, 5)
+            np.testing.assert_allclose(hs, ds, rtol=1e-5)
+            assert set(hi.tolist()) == set(di.tolist())
+        hi, hs = hsrv.items_topk([2, 5], 6)
+        di, ds = dsrv.items_topk([2, 5], 6)
+        np.testing.assert_allclose(np.sort(hs)[::-1], np.sort(ds)[::-1],
+                                   rtol=1e-4)
+        assert set(hi.tolist()) == set(di.tolist())
+
+    def test_users_topk_batch(self, factors):
+        from predictionio_tpu.ops.serving import HostTopK
+
+        X, Y, seen = factors
+        hsrv = HostTopK(X, Y, seen)
+        idx, scores = hsrv.users_topk([0, 3, 19], 5)
+        assert idx.shape == (3, 5)
+        for row, uid in enumerate((0, 3, 19)):
+            i1, s1 = hsrv.user_topk(uid, 5)
+            valid = np.isfinite(scores[row])
+            assert idx[row][valid].tolist() == i1.tolist()
+
+    def test_padded_rows_never_served(self, factors):
+        from predictionio_tpu.ops.serving import HostTopK
+
+        X, Y, seen = factors
+        idx, _ = HostTopK(X, Y, seen, n_items=30).user_topk(1, 33)
+        assert idx.max() < 30
+
+    def test_choose_server_policy(self, factors, monkeypatch):
+        from predictionio_tpu.ops.serving import (
+            HostTopK, choose_server,
+        )
+
+        X, Y, seen = factors
+        # auto: small host factors -> host backend
+        assert isinstance(choose_server(X, Y, seen), HostTopK)
+        # forced device
+        monkeypatch.setenv("PIO_SERVING_BACKEND", "device")
+        assert isinstance(choose_server(X, Y, seen), DeviceTopK)
+        # sharded/device factors always device even on auto
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("PIO_SERVING_BACKEND", "auto")
+        srv = choose_server(jnp.asarray(X), jnp.asarray(Y), seen)
+        assert isinstance(srv, DeviceTopK)
+        # host backend refuses device-resident factors
+        monkeypatch.setenv("PIO_SERVING_BACKEND", "host")
+        with pytest.raises(ValueError):
+            choose_server(jnp.asarray(X), jnp.asarray(Y), seen)
 
 
 def _seed(app_name="recapp"):
@@ -185,6 +280,41 @@ class TestShardedFlavor:
         seen_items = set(model.item_map.decode(model.seen[uidx]))
         full = algo.predict(model, Query(user="u1", num=50))
         assert not ({s.item for s in full.item_scores} & seen_items)
+
+    def test_batch_predict_matches_per_query(self, mem_storage):
+        """batch_predict groups user queries into users_topk dispatches;
+        results must equal the per-query path, including blacklists,
+        unknown users, and item-similarity queries mixed in."""
+        from predictionio_tpu.templates.recommendation import (
+            Query, sharded_engine_factory,
+        )
+
+        _seed()
+        engine = sharded_engine_factory()
+        params = _engine_params()
+        persistable = engine.train(CTX, params, "tb")
+        [model] = engine.prepare_deploy(CTX, params, "tb", persistable)
+        algo = engine._algorithms(params)[0]
+        some_item = model.item_map.decode(np.asarray([0]))[0]
+        queries = [
+            (0, Query(user="u1", num=5)),
+            (1, Query(user="u2", num=5)),
+            (2, Query(user="nobody", num=5)),            # unknown user
+            (3, Query(user="u3", num=5, blacklist=(some_item,))),
+            (4, Query(items=(some_item,), num=4)),        # similarity
+            (5, Query(user="u4", num=3)),                 # different num
+        ]
+        batched = dict(algo.batch_predict(CTX, model, queries))
+        for qx, q in queries:
+            single = algo.predict(model, q)
+            # the vmapped program may fuse differently -> ULP-level score
+            # diffs; the recommended items and ranking must be identical
+            assert [s.item for s in batched[qx].item_scores] == \
+                [s.item for s in single.item_scores], f"query {qx} diverged"
+            np.testing.assert_allclose(
+                [s.score for s in batched[qx].item_scores],
+                [s.score for s in single.item_scores], rtol=1e-5)
+        assert batched[0].item_scores  # non-trivial results came back
 
     def test_retrain_persistence_mode(self, mem_storage):
         """Sharded models are never pickled: run_train stores RETRAIN and
