@@ -56,6 +56,13 @@ BACKENDS: Dict[str, Dict[str, str]] = {
     "localfs": {
         "Models": "predictionio_tpu.data.storage.localfs:LocalFSModels",
     },
+    # EVENTDATA-only partitioned JSONL store — the scale-ingest backend
+    # (JDBCPEvents.scala:31-100 / HBPEvents.scala:83-89 analog); config
+    # keys: PATH, PART_MAX_EVENTS
+    "jsonlfs": {
+        "LEvents": "predictionio_tpu.data.storage.jsonlfs:JsonlFsLEvents",
+        "PEvents": "predictionio_tpu.data.storage.jsonlfs:JsonlFsPEvents",
+    },
 }
 
 

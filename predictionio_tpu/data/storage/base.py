@@ -181,6 +181,36 @@ class PEvents(abc.ABC):
             value_property=value_property, default_value=default_value,
             strict=strict)
 
+    def find_columnar_blocks(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        value_property: Optional[str] = None,
+        default_value: float = 1.0,
+        strict: bool = True,
+        block_size: int = 1_000_000,
+    ):
+        """Streaming bulk scan: yields :class:`ColumnarEvents` blocks of at
+        most ``block_size`` rows, in STORAGE order (not time order) — the
+        scale-ingest contract (the reference partitions bulk reads the same
+        way: per time range ``JDBCPEvents.scala:31-100``, per HBase region
+        ``HBPEvents.scala:83-89``). Backends override so a block's memory
+        is bounded; this default slices one materialized scan and only
+        bounds what downstream consumers hold."""
+        batch = self.find_columnar(
+            app_id=app_id, channel_id=channel_id, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            event_names=event_names, target_entity_type=target_entity_type,
+            value_property=value_property, default_value=default_value,
+            strict=strict)
+        for i in range(0, len(batch), block_size):
+            yield batch.take(slice(i, i + block_size))
+
     def aggregate_properties(
         self,
         app_id: int,

@@ -488,9 +488,61 @@ class SqlitePEvents(base.LEventsBackedPEvents):
                 value_property=value_property, default_value=default_value,
                 strict=strict)
 
+        sql, args = self._columnar_sql(
+            app_id, channel_id, start_time, until_time, entity_type,
+            event_names, target_entity_type, value_property,
+            order="event_time ASC")
+        rows = list(self._l._client.query_iter(sql, args))
+        return self._columnar_rows(rows, value_property, default_value,
+                                   strict)
+
+    def find_columnar_blocks(self, app_id, channel_id=None, start_time=None,
+                             until_time=None, entity_type=None,
+                             event_names=None, target_entity_type=UNSET,
+                             value_property=None, default_value=1.0,
+                             strict=True, block_size=1_000_000):
+        """Streaming scan via rowid keyset pagination — fixed-size
+        columnar blocks in storage (rowid) order, never materializing the
+        whole result set (the JDBCPEvents.scala:31-100 partitioned-read
+        analog). Falls back to the generic sliced scan for exotic
+        property names (same reason as find_columnar)."""
+        if value_property is not None and '"' in value_property:
+            yield from super().find_columnar_blocks(
+                app_id, channel_id=channel_id, start_time=start_time,
+                until_time=until_time, entity_type=entity_type,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                value_property=value_property, default_value=default_value,
+                strict=strict, block_size=block_size)
+            return
+        last_rowid = -1
+        while True:
+            sql, args = self._columnar_sql(
+                app_id, channel_id, start_time, until_time, entity_type,
+                event_names, target_entity_type, value_property,
+                order="rowid ASC", rowid_after=last_rowid,
+                limit=int(block_size), with_rowid=True)
+            rows = list(self._l._client.query_iter(sql, args))
+            if not rows:
+                return
+            last_rowid = int(rows[-1][-1])
+            yield self._columnar_rows([r[:-1] for r in rows],
+                                      value_property, default_value, strict)
+            if len(rows) < block_size:
+                return
+
+    def _columnar_sql(self, app_id, channel_id, start_time, until_time,
+                      entity_type, event_names, target_entity_type,
+                      value_property, *, order: str,
+                      rowid_after: Optional[int] = None,
+                      limit: Optional[int] = None,
+                      with_rowid: bool = False):
         lev = self._l
         where = ["app_id=?", "channel_id=?"]
         args: List[Any] = [int(app_id), lev._chan(channel_id)]
+        if rowid_after is not None:
+            where.append("rowid>?")
+            args.append(int(rowid_after))
         if start_time is not None:
             where.append("event_time>=?")
             args.append(_ts(start_time))
@@ -513,7 +565,7 @@ class SqlitePEvents(base.LEventsBackedPEvents):
         if value_property is not None:
             # json_type distinguishes numbers from booleans (both extract
             # as ints) and from missing/null keys; the type column drives
-            # the strict-mode check below
+            # the strict-mode check in _columnar_rows
             prop_path = '$."' + value_property + '"'
             value_col = ("json_extract(properties, ?), "
                          "json_type(properties, ?)")
@@ -521,10 +573,19 @@ class SqlitePEvents(base.LEventsBackedPEvents):
             args = [prop_path, prop_path] + args
         else:
             value_col = "NULL, NULL"
+        rowid_col = ", rowid" if with_rowid else ""
         sql = (f"SELECT entity_id, target_entity_id, {value_col}, event_time,"
-               f" event FROM events WHERE {' AND '.join(where)}"
-               " ORDER BY event_time ASC")
-        rows = list(lev._client.query_iter(sql, args))
+               f" event{rowid_col} FROM events"
+               f" WHERE {' AND '.join(where)} ORDER BY {order}")
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        return sql, args
+
+    def _columnar_rows(self, rows, value_property, default_value, strict):
+        import numpy as np
+
+        from predictionio_tpu.data.columnar import ColumnarEvents
+
         n = len(rows)
         ents = np.empty(n, dtype=object)
         tgts = np.empty(n, dtype=object)

@@ -99,6 +99,32 @@ class PEventStore:
             value_property=value_property, default_value=default_value,
             strict=strict)
 
+    @staticmethod
+    def find_columnar_blocks(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        value_property: Optional[str] = None,
+        default_value: float = 1.0,
+        strict: bool = True,
+        block_size: int = 1_000_000,
+    ):
+        """Streaming bulk read: ColumnarEvents blocks in storage order —
+        the ≥10M-event ingest path (partitioned reads like
+        JDBCPEvents.scala:31-100 / HBPEvents.scala:83-89; backends bound
+        per-block memory)."""
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        return storage.get_pevents().find_columnar_blocks(
+            app_id=app_id, channel_id=channel_id, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            event_names=event_names, target_entity_type=target_entity_type,
+            value_property=value_property, default_value=default_value,
+            strict=strict, block_size=block_size)
+
 
 class LEventStoreTimeoutError(TimeoutError):
     """Predict-time read exceeded its deadline (the reference's

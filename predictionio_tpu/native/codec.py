@@ -59,6 +59,11 @@ class ParsedEvents:
     lineno: np.ndarray           # int64 1-based source line numbers
     line_start: np.ndarray       # raw-buffer byte spans (fallback re-parse)
     line_end: np.ndarray
+    # numeric-property extraction (ingest value column), when requested:
+    # status 0 = absent/null, 1 = numeric (value in prop_value),
+    # 2 = present but non-numeric
+    prop_value: Optional[np.ndarray] = None   # float64
+    prop_status: Optional[np.ndarray] = None  # uint8
 
     def __len__(self) -> int:
         return len(self.event)
@@ -93,6 +98,10 @@ def _lib():
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
         lib.pio_jsonl_free.restype = None
         lib.pio_jsonl_free.argtypes = [ctypes.c_void_p]
+        lib.pio_jsonl_extract_numeric.restype = None
+        lib.pio_jsonl_extract_numeric.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_uint8)]
         lib._pio_sigs = True
     return lib
 
@@ -128,16 +137,30 @@ def _col(lib, handle, col: int, n: int) -> List[Optional[str]]:
     return out
 
 
-def parse_jsonl(data: bytes) -> Optional[ParsedEvents]:
+def parse_jsonl(data: bytes,
+                numeric_property: Optional[str] = None,
+                columns: Optional[set] = None
+                ) -> Optional[ParsedEvents]:
     """Parse a JSON-lines event buffer natively; None if the native lib
-    is unavailable (callers use the pure-python path then)."""
+    is unavailable (callers use the pure-python path then).
+
+    ``numeric_property`` additionally extracts that top-level properties
+    key as a numeric column in C++ (``prop_value``/``prop_status``) — the
+    training-ingest value column without per-row Python JSON parsing.
+
+    ``columns`` (COL_* ids) restricts which string columns are
+    materialized as Python lists — the per-row str construction is the
+    dominant decode cost, so bulk-ingest callers fetch only what they
+    read; excluded columns are ``None`` on the result."""
     lib = _lib()
     if lib is None:
         return None
     handle = lib.pio_jsonl_parse(data, len(data))
     try:
         n = lib.pio_jsonl_count(handle)
-        cols = [_col(lib, handle, c, n) for c in range(12)]
+        cols = [_col(lib, handle, c, n)
+                if columns is None or c in columns else None
+                for c in range(12)]
         et = np.empty(n, dtype=np.float64)
         ct = np.empty(n, dtype=np.float64)
         lib.pio_jsonl_times(
@@ -168,6 +191,16 @@ def parse_jsonl(data: bytes) -> Optional[ParsedEvents]:
             bad_prop_key=cols[COL_BAD_PROP_KEY],
             event_time=et, creation_time=ct, flags=flags, lineno=lineno,
             line_start=starts, line_end=ends)
+        if numeric_property is not None:
+            pv = np.empty(n, dtype=np.float64)
+            ps = np.empty(n, dtype=np.uint8)
+            kb = numeric_property.encode("utf-8")
+            lib.pio_jsonl_extract_numeric(
+                handle, kb, len(kb),
+                pv.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                ps.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            parsed.prop_value = pv
+            parsed.prop_status = ps
         return parsed
     finally:
         lib.pio_jsonl_free(handle)

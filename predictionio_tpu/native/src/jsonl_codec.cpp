@@ -616,4 +616,69 @@ void pio_jsonl_lines(void* h, int64_t* start, int64_t* end, int64_t* lineno) {
 
 void pio_jsonl_free(void* h) { delete static_cast<Result*>(h); }
 
+// Extract one top-level numeric property per row from the raw
+// `properties` slices — the training-ingest value column (e.g. "rating")
+// without any per-row Python JSON parsing. Per row:
+//   status 0 = key absent or JSON null (caller applies default_value)
+//   status 1 = numeric; out[i] holds the value
+//   status 2 = present but non-numeric (bool/string/object/array —
+//              python's isinstance((int,float)) excludes bool)
+// Duplicate keys follow json.loads last-wins. Rows whose properties the
+// main parse could not express (fallback / absent) report status 0; the
+// caller's fallback path re-parses those lines wholesale anyway.
+void pio_jsonl_extract_numeric(void* h, const char* key, int64_t keylen,
+                               double* out, uint8_t* status) {
+  Result* r = static_cast<Result*>(h);
+  const Col& c = r->cols[5];
+  const std::string want(key, static_cast<size_t>(keylen));
+  std::string k;
+  for (int64_t i = 0; i < r->n; ++i) {
+    out[i] = NAN;
+    status[i] = 0;
+    if (!c.present[static_cast<size_t>(i)]) continue;
+    Parser pr{c.data.data() + c.offsets[static_cast<size_t>(i)],
+              c.data.data() + c.offsets[static_cast<size_t>(i) + 1]};
+    pr.ws();
+    if (pr.p >= pr.end || *pr.p != '{') continue;
+    ++pr.p;
+    pr.ws();
+    if (pr.p < pr.end && *pr.p == '}') continue;
+    while (true) {
+      pr.ws();
+      if (!pr.string(k)) break;
+      pr.ws();
+      if (pr.p >= pr.end || *pr.p != ':') break;
+      ++pr.p;
+      pr.ws();
+      if (k == want) {
+        if (pr.p < pr.end &&
+            ((*pr.p >= '0' && *pr.p <= '9') || *pr.p == '-')) {
+          const char *ns, *ne;
+          if (!pr.number(&ns, &ne)) break;
+          out[i] = std::strtod(std::string(ns, ne).c_str(), nullptr);
+          status[i] = 1;
+        } else if (pr.p < pr.end && *pr.p == 'n') {
+          if (!pr.lit("null")) break;
+          out[i] = NAN;
+          status[i] = 0;
+        } else {
+          const char *vs, *ve;
+          if (!pr.skip_value(&vs, &ve)) break;
+          out[i] = NAN;
+          status[i] = 2;
+        }
+      } else {
+        const char *vs, *ve;
+        if (!pr.skip_value(&vs, &ve)) break;
+      }
+      pr.ws();
+      if (pr.p < pr.end && *pr.p == ',') {
+        ++pr.p;
+        continue;
+      }
+      break;
+    }
+  }
+}
+
 }  // extern "C"

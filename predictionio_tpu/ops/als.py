@@ -50,6 +50,12 @@ class ALSParams(Params):
     alpha: float = 1.0
     implicit_prefs: bool = True
     seed: Optional[int] = None
+    # rows per solve block: bounds the [block, L, R] factor gather that
+    # dominates HBM at scale (10M+ ratings). None solves all rows in one
+    # batch; a set value runs the row blocks sequentially on device
+    # (lax.map) — identical solves (factor init differs only if padding
+    # rows were added to reach a block multiple).
+    solve_block_rows: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -119,6 +125,22 @@ def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
     out_w[rows, pos] = values
     out_m[rows, pos] = 1.0
     return PaddedRatings(out_cols, out_w, out_m, n_rows, n_cols)
+
+
+def _pad_rows(side: PaddedRatings, block: int) -> PaddedRatings:
+    """Pad the row dimension to a multiple of ``block`` with empty rows
+    (zero mask -> zero factors) for the blocked solve path. Host-side
+    numpy op: the blocked path expects host tables (it is the scale
+    ingest route; the transfer happens once inside train_als)."""
+    n = side.n_rows
+    pad = (-n) % block
+    if pad == 0:
+        return side
+    def z(a):
+        return np.concatenate(
+            [np.asarray(a), np.zeros((pad, a.shape[1]), dtype=a.dtype)])
+    return PaddedRatings(z(side.cols), z(side.weights), z(side.mask),
+                         n + pad, side.n_cols)
 
 
 def transpose_ratings(pr: PaddedRatings, rows: np.ndarray, cols: np.ndarray,
@@ -200,16 +222,40 @@ def _solve_side(Y, cols, weights, mask, lam: float, alpha: float,
     return zero_empty_rows(X, mask)
 
 
+def _solve_side_blocked(Y, cols, weights, mask, lam: float, alpha: float,
+                        implicit: bool, block: Optional[int]):
+    """`_solve_side`, optionally over sequential row blocks (lax.map) so
+    the [block, L, R] gather — the HBM peak — is bounded regardless of
+    row count. Caller guarantees rows % block == 0 (train_als pads)."""
+    import jax
+
+    B, L = cols.shape
+    if not block or B <= block:
+        return _solve_side(Y, cols, weights, mask, lam, alpha, implicit)
+    nb = B // block
+
+    def one(args):
+        c, w, m = args
+        return _solve_side(Y, c, w, m, lam, alpha, implicit)
+
+    X = jax.lax.map(one, (cols.reshape(nb, block, L),
+                          weights.reshape(nb, block, L),
+                          mask.reshape(nb, block, L)))
+    return X.reshape(B, -1)
+
+
 def _als_iterations_impl(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m, *, lam,
-                         alpha, implicit, num_iterations):
+                         alpha, implicit, num_iterations, block=None):
     """Full training loop as one compiled program (lax.scan over
     iterations; no data-dependent Python control flow)."""
     import jax
 
     def body(carry, _):
         X, Y = carry
-        X = _solve_side(Y, u_cols, u_w, u_m, lam, alpha, implicit)
-        Y = _solve_side(X, i_cols, i_w, i_m, lam, alpha, implicit)
+        X = _solve_side_blocked(Y, u_cols, u_w, u_m, lam, alpha, implicit,
+                                block)
+        Y = _solve_side_blocked(X, i_cols, i_w, i_m, lam, alpha, implicit,
+                                block)
         return (X, Y), None
 
     (X, Y), _ = jax.lax.scan(body, (X, Y), None, length=num_iterations)
@@ -227,7 +273,8 @@ def _als_iterations(*args, **kw):
 
         _als_iterations_jit = jax.jit(
             _als_iterations_impl,
-            static_argnames=("lam", "alpha", "implicit", "num_iterations"))
+            static_argnames=("lam", "alpha", "implicit", "num_iterations",
+                             "block"))
     return _als_iterations_jit(*args, **kw)
 
 
@@ -258,8 +305,21 @@ def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
 
     assert user_side.n_rows == item_side.n_cols
     assert user_side.n_cols == item_side.n_rows
-    X, Y = init_factors(user_side.n_rows, user_side.n_cols, params.rank,
+    n_u, n_i = user_side.n_rows, user_side.n_cols
+    block = params.solve_block_rows
+    if block:
+        # pad both row dims to a block multiple; extra rows have empty
+        # masks -> zero factors after their first solve
+        user_side = _pad_rows(user_side, block)
+        item_side = _pad_rows(item_side, block)
+    X, Y = init_factors(user_side.n_rows, item_side.n_rows, params.rank,
                         params.seed, dtype)
+    if block:
+        # the random init filled the pad rows too — zero them NOW, or the
+        # first half-iteration's shared Gram term (Y^T Y over all rows,
+        # _solve_side) would see phantom random factors
+        X = X.at[n_u:].set(0.0)
+        Y = Y.at[n_i:].set(0.0)
     u_cols = jnp.asarray(user_side.cols)
     u_w = jnp.asarray(user_side.weights)
     u_m = jnp.asarray(user_side.mask)
@@ -270,8 +330,9 @@ def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
         X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m,
         lam=float(params.lambda_), alpha=float(params.alpha),
         implicit=bool(params.implicit_prefs),
-        num_iterations=int(params.num_iterations))
-    return np.asarray(X), np.asarray(Y)
+        num_iterations=int(params.num_iterations),
+        block=None if not block else int(block))
+    return np.asarray(X)[:n_u], np.asarray(Y)[:n_i]
 
 
 # ---------------------------------------------------------------------------

@@ -49,9 +49,15 @@ from predictionio_tpu.ops.als import (
 
 @dataclasses.dataclass(frozen=True)
 class DataSourceParams(Params):
+    """``streaming_block_size`` switches the read to the scale-ingest
+    path: columnar blocks streamed through an incremental indexer, so a
+    10–20M-rating store is never materialized as whole-store object
+    columns (SURVEY hard part #2); None keeps the single-scan read."""
+
     app_name: str
     event_names: Tuple[str, ...] = ("rate",)
     channel_name: Optional[str] = None
+    streaming_block_size: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -115,15 +121,59 @@ class TrainingData:
             "DataSource generates TrainingData correctly.")
 
 
+class IndexedTrainingData:
+    """Already-indexed rating triples from the streaming ingest: dense
+    int64 user/item codes plus their BiMaps. The Preparator recognizes
+    this and skips re-indexing (the whole point — the string columns
+    were never materialized)."""
+
+    def __init__(self, user_map: StringIndexBiMap,
+                 item_map: StringIndexBiMap, rows: np.ndarray,
+                 cols: np.ndarray, values: np.ndarray):
+        self.user_map = user_map
+        self.item_map = item_map
+        self.rows = rows
+        self.cols = cols
+        self.values = values
+
+    def __len__(self) -> int:
+        return int(self.rows.shape[0])
+
+    def sanity_check(self) -> None:
+        assert len(self), (
+            "ratings in TrainingData cannot be empty. Please check if "
+            "DataSource generates TrainingData correctly.")
+
+
 class EventDataSource(PDataSource):
     """Reads rating events (DataSource.scala:31-65): rate -> property
     'rating', view -> implicit count of 1. Uses the columnar bulk-read
-    path so no per-event Python objects are built."""
+    path so no per-event Python objects are built; with
+    ``streaming_block_size`` set, the read streams bounded blocks
+    through an incremental indexer (the partitioned-read analog of
+    JDBCPEvents.scala:31-100)."""
 
     params_class = DataSourceParams
 
-    def read_training(self, ctx: ComputeContext) -> TrainingData:
+    def read_training(self, ctx: ComputeContext) -> Any:
         p: DataSourceParams = self.params
+        if p.streaming_block_size:
+            from predictionio_tpu.data.columnar import (
+                StreamingRatingsBuilder,
+            )
+
+            builder = StreamingRatingsBuilder()
+            for block in PEventStore.find_columnar_blocks(
+                    app_name=p.app_name,
+                    channel_name=p.channel_name,
+                    entity_type="user",
+                    event_names=list(p.event_names),
+                    target_entity_type="item",
+                    value_property="rating",
+                    default_value=1.0,
+                    block_size=int(p.streaming_block_size)):
+                builder.add_block(block)
+            return IndexedTrainingData(*builder.finalize())
         batch = PEventStore.find_columnar(
             app_name=p.app_name,
             channel_name=p.channel_name,
@@ -141,6 +191,11 @@ class EventDataSource(PDataSource):
         actual; query asks for top-N (readEval analog in the template's
         evaluation variant)."""
         td = self.read_training(ctx)
+        if isinstance(td, IndexedTrainingData):
+            # eval works on typed ratings; decode the streamed triples
+            td = TrainingData(users=td.user_map.decode(td.rows),
+                              items=td.item_map.decode(td.cols),
+                              values=td.values)
         by_user: Dict[str, List[Rating]] = {}
         for r in td.ratings:
             by_user.setdefault(r.user, []).append(r)
@@ -205,22 +260,45 @@ class PreparedData:
         assert self.user_side.n_cols > 0, "no items after indexing"
 
 
+@dataclasses.dataclass(frozen=True)
+class PreparatorParams(Params):
+    """``max_len`` bounds the padded row length (keeping the
+    largest-magnitude ratings per row) — required at 10M+ scale where
+    the power-law tail would otherwise size the whole [N, L] table."""
+
+    max_len: Optional[int] = None
+
+
 class RatingsPreparator(PPreparator):
     """BiMap.stringInt indexing + ALX padding (the reference does the BiMap
     step inside ALSAlgorithm.train, ALSAlgorithm.scala:35-36; here it is a
-    proper Preparator so multiple algorithms share the layout)."""
+    proper Preparator so multiple algorithms share the layout). Accepts
+    either a :class:`TrainingData` (indexes it here) or an
+    :class:`IndexedTrainingData` from the streaming ingest (already
+    indexed — no whole-store string columns ever existed)."""
 
-    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
-        u_labels, rows = np.unique(td.users.astype(str), return_inverse=True)
-        i_labels, cols = np.unique(td.items.astype(str), return_inverse=True)
-        user_map = StringIndexBiMap.from_distinct(u_labels)
-        item_map = StringIndexBiMap.from_distinct(i_labels)
+    params_class = PreparatorParams
+
+    def prepare(self, ctx: ComputeContext, td: Any) -> PreparedData:
+        if isinstance(td, IndexedTrainingData):
+            user_map, item_map = td.user_map, td.item_map
+            rows = np.asarray(td.rows, dtype=np.int64)
+            cols = np.asarray(td.cols, dtype=np.int64)
+            vals = np.asarray(td.values, dtype=np.float32)
+        else:
+            u_labels, rows = np.unique(td.users.astype(str),
+                                       return_inverse=True)
+            i_labels, cols = np.unique(td.items.astype(str),
+                                       return_inverse=True)
+            user_map = StringIndexBiMap.from_distinct(u_labels)
+            item_map = StringIndexBiMap.from_distinct(i_labels)
+            rows = rows.astype(np.int64)
+            cols = cols.astype(np.int64)
+            vals = np.asarray(td.values, dtype=np.float32)
         n_u, n_i = len(user_map), len(item_map)
-        rows = rows.astype(np.int64)
-        cols = cols.astype(np.int64)
-        vals = np.asarray(td.values, dtype=np.float32)
-        user_side = pad_ratings(rows, cols, vals, n_u, n_i)
-        item_side = pad_ratings(cols, rows, vals, n_i, n_u)
+        max_len = getattr(self.params, "max_len", None)
+        user_side = pad_ratings(rows, cols, vals, n_u, n_i, max_len=max_len)
+        item_side = pad_ratings(cols, rows, vals, n_i, n_u, max_len=max_len)
         # per-user seen-item lists via one stable sort (vs n_u boolean scans)
         order = np.argsort(rows, kind="stable")
         s_rows, s_cols = rows[order], cols[order]

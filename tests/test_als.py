@@ -190,6 +190,73 @@ class TestNumerics:
         err = np.abs(pred - vals).mean() / np.abs(vals).mean()
         assert err < 0.35
 
+    def test_blocked_solves_match_unblocked(self):
+        """solve_block_rows bounds HBM without changing the math: when
+        the row counts are block multiples (no pad rows, so the seeded
+        init is shape-identical) the factors match exactly."""
+        rows, cols, vals = synthetic_ratings(n_users=64, n_items=32,
+                                             seed=5)
+        us = pad_ratings(rows, cols, vals, 64, 32)
+        its = pad_ratings(cols, rows, vals, 32, 64)
+        base = ALSParams(rank=4, num_iterations=3, seed=2)
+        X0, Y0 = train_als(us, its, base)
+        import dataclasses as dc
+
+        X1, Y1 = train_als(us, its,
+                           dc.replace(base, solve_block_rows=16))
+        np.testing.assert_allclose(X0, X1, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(Y0, Y1, rtol=1e-5, atol=1e-6)
+
+    def test_blocked_with_row_padding(self):
+        """Non-multiple row counts get padded internally; outputs keep
+        the true shapes and stay finite/useful."""
+        rows, cols, vals = synthetic_ratings(n_users=50, n_items=30,
+                                             seed=6)
+        us = pad_ratings(rows, cols, vals, 50, 30)
+        its = pad_ratings(cols, rows, vals, 30, 50)
+        X, Y = train_als(us, its, ALSParams(rank=4, num_iterations=3,
+                                            seed=2, solve_block_rows=16))
+        assert X.shape == (50, 4) and Y.shape == (30, 4)
+        assert np.isfinite(X).all() and np.isfinite(Y).all()
+        # learned something: observed pairs outscore random unobserved
+        obs = (X[rows] * Y[cols]).sum(axis=1).mean()
+        rng = np.random.default_rng(0)
+        ur, uc = rng.integers(0, 50, 500), rng.integers(0, 30, 500)
+        rand = (X[ur] * Y[uc]).sum(axis=1).mean()
+        assert obs > rand
+
+    def test_blocked_padding_rows_never_pollute_gram(self):
+        """Regression: _pad_rows-added rows must enter the shared Gram
+        term as ZEROS from iteration one (the random init fills them
+        too). Oracle: unblocked iterations on the same padded problem
+        with explicitly zeroed pad-row init."""
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops.als import (
+            _als_iterations_impl, _pad_rows, init_factors,
+        )
+
+        rows, cols, vals = synthetic_ratings(n_users=50, n_items=30,
+                                             seed=7)
+        us = pad_ratings(rows, cols, vals, 50, 30)
+        its = pad_ratings(cols, rows, vals, 30, 50)
+        params = ALSParams(rank=4, num_iterations=2, seed=3,
+                           solve_block_rows=16)
+        Xb, Yb = train_als(us, its, params)
+
+        usp, itp = _pad_rows(us, 16), _pad_rows(its, 16)  # 64 / 32 rows
+        X0, Y0 = init_factors(usp.n_rows, itp.n_rows, 4, 3)
+        X0, Y0 = X0.at[50:].set(0.0), Y0.at[30:].set(0.0)
+        Xo, Yo = _als_iterations_impl(
+            X0, Y0, jnp.asarray(usp.cols), jnp.asarray(usp.weights),
+            jnp.asarray(usp.mask), jnp.asarray(itp.cols),
+            jnp.asarray(itp.weights), jnp.asarray(itp.mask),
+            lam=0.01, alpha=1.0, implicit=True, num_iterations=2)
+        np.testing.assert_allclose(Xb, np.asarray(Xo)[:50], rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(Yb, np.asarray(Yo)[:30], rtol=1e-5,
+                                   atol=1e-6)
+
     def test_deterministic_given_seed(self):
         rows, cols, vals = synthetic_ratings(20, 15, 3, 0.4)
         a = train_als(pad_ratings(rows, cols, vals, 20, 15),

@@ -2,7 +2,10 @@
 
 Mirrors the reference pattern of one shared behavior suite run against every
 backend (``LEventsSpec.scala:22-66`` — "Events can be implemented by:
-HBLEvents / JDBCLEvents"). Here: memory and sqlite.
+HBLEvents / JDBCLEvents"). Here: memory, sqlite, and jsonlfs (events-only —
+its metadata DAOs are memory stand-ins, so only the LEvents classes add
+coverage on that row; a small part_max_events forces multi-partition
+behavior through every test).
 """
 
 import datetime as dt
@@ -26,9 +29,22 @@ UTC = dt.timezone.utc
 APP = 1
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "jsonlfs"])
 def backend(request, tmp_path):
-    if request.param == "memory":
+    if request.param == "jsonlfs":
+        from predictionio_tpu.data.storage.jsonlfs import JsonlFsLEvents
+
+        make = {
+            "levents": lambda cfg: JsonlFsLEvents(
+                {"path": str(tmp_path / "events"), "part_max_events": 3}),
+            "apps": MemApps, "access_keys": MemAccessKeys,
+            "channels": MemChannels,
+            "engine_instances": MemEngineInstances,
+            "evaluation_instances": MemEvaluationInstances,
+            "models": MemModels,
+        }
+        cfg = {}
+    elif request.param == "memory":
         make = {
             "levents": MemLEvents, "apps": MemApps,
             "access_keys": MemAccessKeys, "channels": MemChannels,
