@@ -299,9 +299,19 @@ class Engine:
                    params: Optional[WorkflowParams] = None
                    ) -> List[Tuple[EngineParams,
                                    List[Tuple[Any, List[Tuple[Any, Any, Any]]]]]]:
-        """Evaluate every params set (BaseEngine.scala:79-87 naive loop;
-        FastEvalEngine memoizes shared prefixes)."""
-        return [(ep, self.eval(ctx, ep, params)) for ep in engine_params_list]
+        """Evaluate every params set, thread-parallel (the reference runs
+        this sweep with parallel collections, MetricEvaluator.scala:221-230;
+        param sets are independent full evals, so threads overlap host
+        work and keep the device queue fed). ``WorkflowParams.
+        eval_parallelism`` controls the width (1 = serial)."""
+        from predictionio_tpu.utils.concurrency import (
+            eval_workers, parallel_map,
+        )
+
+        wp = params or WorkflowParams()
+        workers = eval_workers(wp.eval_parallelism, len(engine_params_list))
+        return parallel_map(lambda ep: (ep, self.eval(ctx, ep, params)),
+                            engine_params_list, workers)
 
     # -- variant JSON -> EngineParams (Engine.scala:354-417) --------------
     def engine_params_from_variant(

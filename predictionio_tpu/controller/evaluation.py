@@ -11,9 +11,9 @@ Parity targets:
   JSON (``saveEngineJson`` ``:190-213``).
 
 The reference scores param sets with Scala parallel collections
-(``.par``, ``MetricEvaluator.scala:221-230``); metric scoring here is
-cheap host arithmetic (the heavy train/predict work already happened in
-``batch_eval``), so it stays a plain loop.
+(``.par``, ``MetricEvaluator.scala:221-230``); scoring here is likewise
+thread-parallel over param sets (``WorkflowParams.eval_parallelism``),
+as is the heavy ``Engine.batch_eval`` sweep that feeds it.
 """
 
 from __future__ import annotations
@@ -162,13 +162,25 @@ class MetricEvaluator(BaseEvaluator):
             raise ValueError(
                 "MetricEvaluator needs at least one (EngineParams, eval "
                 "output) pair; got an empty engine_eval_data_set")
-        scored: List[Tuple[EngineParams, MetricScores]] = []
-        for engine_params, eval_data_set in engine_eval_data_set:
-            scores = MetricScores(
+
+        # thread-parallel scoring over param sets (the reference's `.par`
+        # map, MetricEvaluator.scala:221-230); order preserved
+        from predictionio_tpu.utils.concurrency import (
+            eval_workers, parallel_map,
+        )
+
+        def score_one(pair):
+            engine_params, eval_data_set = pair
+            return (engine_params, MetricScores(
                 score=self.metric.calculate(ctx, eval_data_set),
                 other_scores=[m.calculate(ctx, eval_data_set)
-                              for m in self.other_metrics])
-            scored.append((engine_params, scores))
+                              for m in self.other_metrics]))
+
+        workers = eval_workers(
+            params.eval_parallelism if params is not None else 0,
+            len(engine_eval_data_set))
+        scored: List[Tuple[EngineParams, MetricScores]] = parallel_map(
+            score_one, engine_eval_data_set, workers)
 
         for idx, (ep, r) in enumerate(scored):
             logger.info("Iteration %d", idx)

@@ -53,6 +53,13 @@ class WorkflowParams:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
+    # Worker threads for param-set evaluation (the reference's `.par`
+    # sweeps, MetricEvaluator.scala:221-230 / FastEvalEngine.scala:176).
+    # 0 -> a CPU-count-based default (PARALLEL, like the reference), so
+    # user controllers/metrics must tolerate concurrent param-set
+    # evaluation — exactly as they must under Spark/.par there; set 1 to
+    # force a serial sweep for thread-unsafe user code.
+    eval_parallelism: int = 0
 
 
 class TrainingInterruption(Exception):
