@@ -37,7 +37,27 @@ class SSLConfiguration:
         )
         return ctx
 
-    def wrap_server(self, httpd) -> None:
-        """Wrap an ``http.server`` instance's listening socket in TLS."""
+    def wrap_server(self, httpd, handshake_timeout: float = 10.0) -> None:
+        """Wrap an ``http.server`` instance's listening socket in TLS.
+
+        The handshake is deferred off the accept loop
+        (``do_handshake_on_connect=False``) and performed — with a
+        timeout — where the connection is handled (the worker thread
+        under ThreadingMixIn). Otherwise a single client that connects
+        and sends nothing would pin ``accept()`` inside the handshake
+        and block every other connection."""
         httpd.socket = self.ssl_context().wrap_socket(
-            httpd.socket, server_side=True)
+            httpd.socket, server_side=True, do_handshake_on_connect=False)
+        orig_finish = httpd.finish_request
+
+        def finish_request(request, client_address):
+            request.settimeout(handshake_timeout)
+            try:
+                request.do_handshake()
+            except (OSError, ssl.SSLError):
+                httpd.shutdown_request(request)
+                return
+            request.settimeout(None)
+            orig_finish(request, client_address)
+
+        httpd.finish_request = finish_request

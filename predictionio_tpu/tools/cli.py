@@ -169,6 +169,10 @@ def build_parser() -> argparse.ArgumentParser:
     dep.add_argument("--event-server-ip", default="0.0.0.0")
     dep.add_argument("--event-server-port", type=int, default=7070)
     dep.add_argument("--accesskey", default=None)
+    dep.add_argument("--server-config", default=None,
+                     help="server.json with ssl cert/key for HTTPS "
+                          "serving (default: $PIO_SERVER_CONFIG or "
+                          "./server.json)")
     dep.set_defaults(func=run_commands.cmd_deploy)
 
     undep = sub.add_parser("undeploy", help="stop a deployed engine server")

@@ -180,6 +180,7 @@ def cmd_deploy(args) -> int:
         event_server_ip=args.event_server_ip,
         event_server_port=args.event_server_port,
         access_key=args.accesskey,
+        server_config_path=getattr(args, "server_config", None),
     )
     try:
         server = QueryServer(config).start()
@@ -188,7 +189,7 @@ def cmd_deploy(args) -> int:
         return 1
     host, port = server.address
     print(f"[INFO] Engine is deployed and running. Engine API is live at "
-          f"http://{host}:{port}.")
+          f"{server.scheme}://{host}:{port}.")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -197,10 +198,13 @@ def cmd_deploy(args) -> int:
 
 
 def cmd_undeploy(args) -> int:
-    """Console undeploy (Console.scala:880-890): stop a running server."""
+    """Console undeploy (Console.scala:880-890): stop a running server.
+    Probes HTTP first, then HTTPS, so it stops servers deployed with a
+    TLS server.json without needing to know which scheme is live."""
     from predictionio_tpu.workflow import undeploy
 
-    if undeploy(args.ip, args.port):
+    if undeploy(args.ip, args.port) \
+            or undeploy(args.ip, args.port, scheme="https"):
         print("[INFO] Undeployed.")
         return 0
     print(f"[ERROR] Nothing at {args.ip}:{args.port} responded to /stop.",

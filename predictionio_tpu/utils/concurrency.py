@@ -17,8 +17,6 @@ from typing import Callable, Iterable, List, Sequence, TypeVar
 T = TypeVar("T")
 R = TypeVar("R")
 
-_SENTINEL = object()
-
 
 def eval_workers(requested: int, n_items: int) -> int:
     """Worker count for a param-set sweep: the requested value, else a

@@ -72,6 +72,12 @@ class ServerConfig:
     access_key: Optional[str] = None
     batch: str = ""
     warmup_query: Optional[Mapping[str, Any]] = None
+    # server.json path with the TLS cert/key (the reference deploys
+    # HTTPS-only via server.conf + SSLConfiguration,
+    # CreateServer.scala:332-339 / SSLConfiguration.scala:50-72); None
+    # checks $PIO_SERVER_CONFIG / ./server.json, and a file without an
+    # "ssl" section serves plain HTTP
+    server_config_path: Optional[str] = None
 
 
 def engine_instance_to_engine_params(
@@ -190,6 +196,7 @@ class QueryServer:
         self.latency = LatencyHistogram()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self.scheme = "http"  # resolved from server.json at start()
 
     # -- deploy ------------------------------------------------------------
     def _resolve_instance(self) -> EngineInstance:
@@ -241,6 +248,27 @@ class QueryServer:
             params=WorkflowParams(batch=self.config.batch))
 
         algorithms = engine._algorithms(engine_params)
+        # every ensemble member must agree on the query type: queries are
+        # extracted with algorithms[0].query_class and fed to ALL of them
+        # (CreateServer.scala:519-525 likewise types the whole server by
+        # the first algorithm) — a silent mismatch would crash or
+        # mis-parse at query time, so refuse at deploy
+        declared = {a.query_class for a in algorithms
+                    if a.query_class is not None}
+        if len(declared) > 1:
+            names = sorted(c.__name__ for c in declared)
+            raise ValueError(
+                f"algorithms declare different query classes {names}; an "
+                "ensemble must share one query type (the server extracts "
+                "queries with the first algorithm's class)")
+        if declared and algorithms[0].query_class is None:
+            # a typed member behind an untyped first algorithm would
+            # receive raw dicts — the same silent mismatch
+            raise ValueError(
+                f"algorithm {type(algorithms[0]).__name__} declares no "
+                f"query class but a later ensemble member expects "
+                f"{next(iter(declared)).__name__}; the first algorithm "
+                "types query extraction for the whole server")
         sv_name, sv_params = engine_params.serving_params
         serving = engine._make(engine.serving_class_map, sv_name, sv_params,
                                "serving")
@@ -408,10 +436,27 @@ class QueryServer:
     # -- HTTP lifecycle ----------------------------------------------------
     def start(self, undeploy_stale: bool = True,
               bind_retries: int = 3) -> "QueryServer":
+        # TLS config first: the stale-server probe and the bind wrap both
+        # depend on the scheme (CreateServer.scala:332-339 — the
+        # reference deploys HTTPS via server.conf + SSLConfiguration)
+        from predictionio_tpu.common import SSLConfiguration
+        from predictionio_tpu.common.auth import (
+            ServerConfig as AuthServerConfig,
+        )
+
+        sslc = SSLConfiguration(
+            AuthServerConfig.load(self.config.server_config_path))
+        self.scheme = "https" if sslc.enabled else "http"
         if self._deployment is None:
             self.deploy()
         if undeploy_stale:
-            undeploy(self.config.ip, self.config.port)
+            # a stale server may run the OTHER scheme (operator just
+            # added/removed TLS); probe both so the port always frees
+            if not undeploy(self.config.ip, self.config.port,
+                            scheme=self.scheme):
+                undeploy(self.config.ip, self.config.port,
+                         scheme="http" if self.scheme == "https"
+                         else "https")
         server = self
 
         class Handler(_QueryHandler):
@@ -430,12 +475,16 @@ class QueryServer:
         else:
             raise RuntimeError(
                 f"Bind failed after {bind_retries} tries") from last_err
+        if sslc.enabled:
+            # wrap the listener exactly as the dashboard does
+            sslc.wrap_server(self._httpd)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="pio-queryserver",
             daemon=True)
         self._thread.start()
-        logger.info("Query server started on %s:%d", *self.address)
+        logger.info("Query server started on %s://%s:%d", self.scheme,
+                    *self.address)
         return self
 
     @property
@@ -460,14 +509,25 @@ class QueryServer:
         self._thread.join()
 
 
-def undeploy(ip: str, port: int) -> bool:
+def undeploy(ip: str, port: int, scheme: str = "http") -> bool:
     """POST /stop to a stale server before binding
-    (CreateServer.scala:295-330). True if something answered."""
+    (CreateServer.scala:295-330). True if something answered. With
+    ``scheme="https"`` certificate verification is skipped: the probe
+    talks to our own (commonly self-signed) stale instance on a local
+    port, and the only action is asking it to stop."""
+    import ssl as _ssl
+
     host = "127.0.0.1" if ip == "0.0.0.0" else ip
+    kwargs = {}
+    if scheme == "https":
+        ctx = _ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = _ssl.CERT_NONE
+        kwargs["context"] = ctx
     try:
         req = urllib.request.Request(
-            f"http://{host}:{port}/stop", data=b"", method="POST")
-        with urllib.request.urlopen(req, timeout=3) as resp:
+            f"{scheme}://{host}:{port}/stop", data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=3, **kwargs) as resp:
             logger.info("Undeployed stale server at %s:%d (%d)",
                         host, port, resp.status)
             return True

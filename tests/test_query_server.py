@@ -208,6 +208,228 @@ class TestUndeploy:
             first.stop()
 
 
+class TestEnsembleQueryClassValidation:
+    """Deploy refuses an ensemble whose algorithms disagree on the query
+    type (the server types query extraction by the FIRST algorithm,
+    CreateServer.scala:519-525 — a mismatch would mis-parse silently)."""
+
+    def test_mismatched_query_classes_refused(self, mem_storage):
+        import dataclasses as dc
+
+        from predictionio_tpu.controller import Engine
+        from tests.dase_fixtures import (
+            DataSource0, IdParams, P2LAlgo0, Preparator0, Serving0,
+        )
+
+        @dc.dataclass(frozen=True)
+        class OtherQuery:
+            text: str = ""
+
+        class AlgoA(P2LAlgo0):
+            query_cls = Query  # the template Query
+
+        class AlgoB(P2LAlgo0):
+            query_cls = OtherQuery
+
+        engine = Engine(DataSource0, Preparator0,
+                        {"a": AlgoA, "b": AlgoB}, Serving0)
+        params = EngineParams(
+            data_source_params=("", IdParams(1)),
+            preparator_params=("", IdParams(1)),
+            algorithm_params_list=[("a", IdParams(2)), ("b", IdParams(3))],
+            serving_params=("", IdParams(1)),
+        )
+        cfg = WorkflowConfig(engine_factory="tests:na")
+        iid = run_train(engine, params, new_engine_instance(cfg, params),
+                        ctx=CTX)
+        with pytest.raises(ValueError, match="different query classes"):
+            QueryServer(ServerConfig(ip="127.0.0.1", port=0,
+                                     engine_instance_id=iid),
+                        engine=engine).deploy()
+
+    def test_untyped_first_algorithm_with_typed_member_refused(
+            self, mem_storage):
+        from predictionio_tpu.controller import Engine
+        from tests.dase_fixtures import (
+            DataSource0, IdParams, P2LAlgo0, Preparator0, Serving0,
+        )
+
+        class AlgoUntyped(P2LAlgo0):
+            pass  # no query_cls: extraction would hand raw dicts around
+
+        class AlgoTyped(P2LAlgo0):
+            query_cls = Query
+
+        engine = Engine(DataSource0, Preparator0,
+                        {"a": AlgoUntyped, "b": AlgoTyped}, Serving0)
+        params = EngineParams(
+            data_source_params=("", IdParams(1)),
+            preparator_params=("", IdParams(1)),
+            algorithm_params_list=[("a", IdParams(2)), ("b", IdParams(3))],
+            serving_params=("", IdParams(1)),
+        )
+        cfg = WorkflowConfig(engine_factory="tests:na")
+        iid = run_train(engine, params, new_engine_instance(cfg, params),
+                        ctx=CTX)
+        with pytest.raises(ValueError, match="declares no query class"):
+            QueryServer(ServerConfig(ip="127.0.0.1", port=0,
+                                     engine_instance_id=iid),
+                        engine=engine).deploy()
+
+    def test_shared_query_class_deploys(self, mem_storage):
+        from predictionio_tpu.controller import Engine
+        from tests.dase_fixtures import (
+            DataSource0, IdParams, P2LAlgo0, Preparator0, Serving0,
+        )
+
+        class AlgoA(P2LAlgo0):
+            query_cls = Query
+
+        class AlgoB(P2LAlgo0):
+            query_cls = Query
+
+        engine = Engine(DataSource0, Preparator0,
+                        {"a": AlgoA, "b": AlgoB}, Serving0)
+        params = EngineParams(
+            data_source_params=("", IdParams(1)),
+            preparator_params=("", IdParams(1)),
+            algorithm_params_list=[("a", IdParams(2)), ("b", IdParams(3))],
+            serving_params=("", IdParams(1)),
+        )
+        cfg = WorkflowConfig(engine_factory="tests:na")
+        iid = run_train(engine, params, new_engine_instance(cfg, params),
+                        ctx=CTX)
+        srv = QueryServer(ServerConfig(ip="127.0.0.1", port=0,
+                                       engine_instance_id=iid),
+                          engine=engine)
+        assert srv.deploy() is srv
+
+
+class TestHTTPS:
+    """TLS serving parity (the reference deploys HTTPS-only,
+    CreateServer.scala:332-339 via SSLConfiguration.scala:50-72)."""
+
+    @pytest.fixture
+    def cert(self, tmp_path):
+        import subprocess
+
+        cert, key = tmp_path / "cert.pem", tmp_path / "key.pem"
+        try:
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-nodes", "-keyout", str(key), "-out", str(cert),
+                 "-days", "1", "-subj", "/CN=localhost"],
+                check=True, capture_output=True, timeout=60)
+        except (OSError, subprocess.SubprocessError):
+            pytest.skip("openssl unavailable")
+        server_json = tmp_path / "server.json"
+        server_json.write_text(json.dumps(
+            {"ssl": {"certfile": str(cert), "keyfile": str(key)}}))
+        return str(server_json), str(cert)
+
+    def test_queries_json_over_tls(self, trained, cert):
+        import ssl
+
+        server_json, certfile = cert
+        srv = QueryServer(ServerConfig(
+            ip="127.0.0.1", port=0,
+            server_config_path=server_json)).start(undeploy_stale=False)
+        try:
+            assert srv.scheme == "https"
+            host, port = srv.address
+            ctx = ssl.create_default_context(cafile=certfile)
+            ctx.check_hostname = False  # self-signed, CN only
+            conn = http.client.HTTPSConnection(host, port, timeout=60,
+                                               context=ctx)
+            conn.request("POST", "/queries.json",
+                         body=json.dumps({"user": "u1", "num": 3}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = json.loads(resp.read().decode())
+            conn.close()
+            assert resp.status == 200
+            assert 0 < len(data["itemScores"]) <= 3
+        finally:
+            srv.stop()
+
+    def test_https_undeploy_stale(self, trained, cert):
+        from predictionio_tpu.workflow.create_server import undeploy
+
+        server_json, _ = cert
+        srv = QueryServer(ServerConfig(
+            ip="127.0.0.1", port=0,
+            server_config_path=server_json)).start(undeploy_stale=False)
+        host, port = srv.address
+        try:
+            assert undeploy(host, port, scheme="https") is True
+            for _ in range(50):
+                if srv._httpd is None:
+                    break
+                time.sleep(0.1)
+            assert srv._httpd is None  # /stop shut it down
+        finally:
+            srv.stop()
+
+    def test_silent_client_does_not_block_other_connections(self, trained,
+                                                            cert):
+        """A TCP client that never speaks TLS must not pin the accept
+        loop (handshake runs in the worker thread with a timeout)."""
+        import socket
+        import ssl
+
+        server_json, certfile = cert
+        srv = QueryServer(ServerConfig(
+            ip="127.0.0.1", port=0,
+            server_config_path=server_json)).start(undeploy_stale=False)
+        try:
+            host, port = srv.address
+            silent = socket.create_connection((host, port))  # no bytes
+            try:
+                ctx = ssl.create_default_context(cafile=certfile)
+                ctx.check_hostname = False
+                conn = http.client.HTTPSConnection(host, port, timeout=15,
+                                                   context=ctx)
+                conn.request("POST", "/queries.json",
+                             body=json.dumps({"user": "u1", "num": 2}),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                resp.read()
+                conn.close()
+            finally:
+                silent.close()
+        finally:
+            srv.stop()
+
+    def test_scheme_switch_still_undeploys_stale(self, trained, cert):
+        """An HTTP stale server on the port is replaced by an HTTPS
+        deploy (the probe tries both schemes)."""
+        server_json, _ = cert
+        plain = QueryServer(ServerConfig(ip="127.0.0.1", port=0)).start(
+            undeploy_stale=False)
+        port = plain.address[1]
+        tls = QueryServer(ServerConfig(
+            ip="127.0.0.1", port=port,
+            server_config_path=server_json)).start()
+        try:
+            assert tls.scheme == "https" and tls.address[1] == port
+        finally:
+            tls.stop()
+            plain.stop()
+
+    def test_no_ssl_config_stays_http(self, trained, tmp_path):
+        server_json = tmp_path / "server.json"
+        server_json.write_text(json.dumps({"accessKey": ""}))
+        srv = QueryServer(ServerConfig(
+            ip="127.0.0.1", port=0,
+            server_config_path=str(server_json))).start(
+                undeploy_stale=False)
+        try:
+            assert srv.scheme == "http"
+        finally:
+            srv.stop()
+
+
 class TestHelpers:
     def test_engine_instance_to_engine_params(self, trained):
         instance = storage.get_metadata_engine_instances().get(trained)
