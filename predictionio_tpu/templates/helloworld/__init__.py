@@ -1,0 +1,12 @@
+"""Hello-world L-flavor engine template (day -> average temperature)."""
+
+from predictionio_tpu.templates.helloworld.engine import (  # noqa: F401
+    DataSourceParams,
+    HelloWorldAlgorithm,
+    HelloWorldDataSource,
+    HelloWorldModel,
+    Query,
+    PredictedResult,
+    TrainingData,
+    engine_factory,
+)

@@ -13,6 +13,14 @@ Capability parity with ``examples/scala-parallel-similarproduct``:
   intersection, white/black lists (``ALSAlgorithm.scala:89-135``).
   The reference's per-item ``.par`` cosine map becomes ONE [Q,R]x[M,R]
   matmul + reduction (MXU-shaped).
+- filterbyyear variant: items carry a ``year`` property and queries a
+  ``recommendFromYear`` floor; candidates must satisfy
+  ``year > recommendFromYear`` and results carry the year
+  (``filterbyyear/src/main/scala/ALSAlgorithm.scala:225-240``,
+  ``Engine.scala:10-23``)
+- recommended-user variant: ALS on ``follow`` events (user -> user),
+  user-to-user cosine recommendations with white/black lists
+  (``recommended-user/src/main/scala/ALSAlgorithm.scala:44-168``)
 """
 
 from __future__ import annotations
@@ -52,6 +60,9 @@ class DataSourceParams(Params):
 @dataclasses.dataclass(frozen=True)
 class Item:
     categories: Tuple[str, ...] = ()
+    # filterbyyear variant (DataSource.scala:52/:100 there requires it;
+    # merged template keeps it optional so the base flavor is unchanged)
+    year: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,12 +102,26 @@ class Query:
     categories: Tuple[str, ...] = ()
     white_list: Tuple[str, ...] = ()
     black_list: Tuple[str, ...] = ()
+    # filterbyyear variant: only items with year > this floor recommend
+    # (filterbyyear Engine.scala:12, ALSAlgorithm.scala:231)
+    recommend_from_year: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
 class ItemScore:
     item: str
     score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class YearItemScore:
+    """filterbyyear's ItemScore shape (its Engine.scala:19-23 adds the
+    year). A distinct type so the BASE flavor's wire format stays
+    byte-identical to the reference base template (no `year` key)."""
+
+    item: str
+    score: float
+    year: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,7 +143,8 @@ class EventDataSource(PDataSource):
                 entity_type="user")
         }
         items = {
-            iid: Item(categories=tuple(pm.get_opt("categories", list) or ()))
+            iid: Item(categories=tuple(pm.get_opt("categories", list) or ()),
+                      year=pm.get_opt("year", int))
             for iid, pm in PEventStore.aggregate_properties(
                 app_name=p.app_name, channel_name=p.channel_name,
                 entity_type="item").items()
@@ -164,6 +190,25 @@ class SimilarProductModel:
         assert np.isfinite(self.product_features).all()
 
 
+def _factors_from_ratings(ratings: Dict[Tuple[int, int], float],
+                          n_rows: int, n_cols: int,
+                          p: "ALSAlgorithmParams",
+                          empty_msg: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(row,col)->value dict -> implicit ALS factor pair; the tail every
+    flavor in this module shares."""
+    if not ratings:
+        raise ValueError(empty_msg)
+    keys = np.asarray(list(ratings), dtype=np.int64)
+    vals = np.asarray(list(ratings.values()), dtype=np.float32)
+    params = ALSParams(rank=p.rank, num_iterations=p.num_iterations,
+                       lambda_=p.lambda_,
+                       seed=0 if p.seed is None else p.seed)
+    return _train_als_auto(
+        pad_ratings(keys[:, 0], keys[:, 1], vals, n_rows, n_cols),
+        pad_ratings(keys[:, 1], keys[:, 0], vals, n_cols, n_rows),
+        params)
+
+
 def _train_item_model(ratings: Dict[Tuple[int, int], float],
                       user_map: StringIndexBiMap,
                       item_map: StringIndexBiMap,
@@ -171,23 +216,52 @@ def _train_item_model(ratings: Dict[Tuple[int, int], float],
                       p: "ALSAlgorithmParams") -> SimilarProductModel:
     """Shared (user,item)->rating dict -> implicit ALS -> item-factor
     model tail used by ALSAlgorithm and LikeAlgorithm."""
-    if not ratings:
-        raise ValueError(
-            "ratings cannot be empty. Please check if your events "
-            "contain valid user and item ID.")
-    keys = np.asarray(list(ratings), dtype=np.int64)
-    vals = np.asarray(list(ratings.values()), dtype=np.float32)
-    rows, cols = keys[:, 0], keys[:, 1]
-    n_u, n_i = len(user_map), len(item_map)
-    params = ALSParams(rank=p.rank, num_iterations=p.num_iterations,
-                       lambda_=p.lambda_,
-                       seed=0 if p.seed is None else p.seed)
-    _, item_factors = _train_als_auto(
-        pad_ratings(rows, cols, vals, n_u, n_i),
-        pad_ratings(cols, rows, vals, n_i, n_u),
-        params)
+    _, item_factors = _factors_from_ratings(
+        ratings, len(user_map), len(item_map), p,
+        "ratings cannot be empty. Please check if your events "
+        "contain valid user and item ID.")
     items = {item_map[iid]: item for iid, item in item_meta.items()}
     return SimilarProductModel(item_factors, item_map, items)
+
+
+def _cosine_topk(features: np.ndarray, idxs: List[int], num: int,
+                 id_map: StringIndexBiMap,
+                 white_list: Tuple[str, ...],
+                 black_list: Tuple[str, ...],
+                 extra_mask: Optional[np.ndarray] = None
+                 ) -> List[Tuple[str, float, int]]:
+    """The candidate-filter + top-k shared by every cosine-serving flavor
+    (isCandidateItem / isCandidateSimilarUser in the reference variants):
+    summed cosine scores of the query rows against all rows, keep
+    positive scores, drop the query rows themselves, apply
+    white/black lists (and any variant-specific ``extra_mask``), return
+    ``(decoded id, score, row index)`` descending."""
+    qf = features[np.asarray(idxs, dtype=np.int64)]
+    scores = cosine_scores(qf, features)
+    scores = np.where(np.isfinite(scores), scores, 0.0)
+    mask = scores > 0
+    mask[np.asarray(idxs, dtype=np.int64)] = False
+    if extra_mask is not None:
+        mask &= extra_mask
+    if white_list:
+        white = {id_map[i] for i in white_list if i in id_map}
+        keep = np.zeros_like(mask)
+        if white:
+            keep[np.asarray(list(white), dtype=np.int64)] = True
+        mask &= keep
+    for i in black_list:
+        ix = id_map.get(i)
+        if ix is not None:
+            mask[ix] = False
+    scores = np.where(mask, scores, -np.inf)
+    k = min(num, int(mask.sum()))
+    if k <= 0:
+        return []
+    top = np.argpartition(-scores, k - 1)[:k]
+    top = top[np.argsort(-scores[top])]
+    decoded = id_map.decode(top)
+    return [(str(d), float(scores[ix]), int(ix))
+            for d, ix in zip(decoded, top)]
 
 
 class ALSAlgorithm(P2LAlgorithm):
@@ -217,40 +291,37 @@ class ALSAlgorithm(P2LAlgorithm):
                 if i in model.item_map]
         if not idxs:
             return PredictedResult(())
-        qf = model.product_features[np.asarray(idxs, dtype=np.int64)]
-        # [Q, M] cosines summed over query items (scala :101-110)
-        scores = cosine_scores(qf, model.product_features)
-        scores = np.where(np.isfinite(scores), scores, 0.0)
-
-        mask = scores > 0  # keep positive-score items (scala :109)
-        mask[np.asarray(idxs, dtype=np.int64)] = False  # not the query items
-        if query.categories:
+        extra = None
+        year_filter = query.recommend_from_year is not None
+        if query.categories or year_filter:
+            extra = np.ones(model.product_features.shape[0], dtype=bool)
             cats = set(query.categories)
             for ix, item in model.items.items():
-                if not cats.intersection(item.categories):
-                    mask[ix] = False
-        if query.white_list:
-            white = {model.item_map[i] for i in query.white_list
-                     if i in model.item_map}
-            keep = np.zeros_like(mask)
-            if white:
-                keep[np.asarray(list(white), dtype=np.int64)] = True
-            mask &= keep
-        for i in query.black_list:
-            ix = model.item_map.get(i)
-            if ix is not None:
-                mask[ix] = False
-
-        scores = np.where(mask, scores, -np.inf)
-        k = min(query.num, int(mask.sum()))
-        if k <= 0:
-            return PredictedResult(())
-        top = np.argpartition(-scores, k - 1)[:k]
-        top = top[np.argsort(-scores[top])]
-        items = model.item_map.decode(top)
+                if cats and not cats.intersection(item.categories):
+                    extra[ix] = False
+                # year floor (filterbyyear ALSAlgorithm.scala:231): items
+                # without a year never recommend under this filter,
+                # matching the variant's required `year` property. Old
+                # pickled models may predate the field -> getattr.
+                if year_filter:
+                    year = getattr(item, "year", None)
+                    if year is None or year <= query.recommend_from_year:
+                        extra[ix] = False
+        winners = _cosine_topk(model.product_features, idxs, query.num,
+                               model.item_map, query.white_list,
+                               query.black_list, extra)
+        if year_filter:
+            # the filterbyyear variant's results carry the item year
+            # (its Engine.scala:19-23); the base flavor's wire format
+            # stays untouched
+            return PredictedResult(tuple(
+                YearItemScore(item=item, score=score,
+                              year=getattr(model.items.get(ix, Item()),
+                                           "year", None))
+                for item, score, ix in winners))
         return PredictedResult(tuple(
-            ItemScore(item=str(i), score=float(scores[ix]))
-            for i, ix in zip(items, top)))
+            ItemScore(item=item, score=score)
+            for item, score, _ in winners))
 
 
 class LikeAlgorithm(ALSAlgorithm):
@@ -312,12 +383,135 @@ class MultiServing(LServing):
             for k, v in ranked[:query.num]))
 
 
+# ---------------------------------------------------------------------------
+# recommended-user variant: who to follow
+# (examples/scala-parallel-similarproduct/recommended-user/)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class UserQuery:
+    """recommended-user Engine.scala:6-13: query by user IDs."""
+
+    users: Tuple[str, ...] = ()
+    num: int = 10
+    white_list: Tuple[str, ...] = ()
+    black_list: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class SimilarUserScore:
+    user: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class RecommendedUsersResult:
+    similar_user_scores: Tuple[SimilarUserScore, ...]
+
+
+@dataclasses.dataclass
+class FollowTrainingData:
+    users: Dict[str, None]
+    follow_events: List[ViewEvent]  # user -> followed user (reuses shape)
+
+    def sanity_check(self) -> None:
+        assert self.follow_events, (
+            "followEvents in PreparedData cannot be empty. Please check "
+            "if DataSource generates TrainingData correctly.")
+        assert self.users, "users in PreparedData cannot be empty."
+
+
+class FollowDataSource(PDataSource):
+    """$set users + follow events (recommended-user DataSource.scala:
+    user -> followedUser, both entity types 'user')."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: ComputeContext) -> FollowTrainingData:
+        p: DataSourceParams = self.params
+        users = {
+            uid: None
+            for uid in PEventStore.aggregate_properties(
+                app_name=p.app_name, channel_name=p.channel_name,
+                entity_type="user")
+        }
+        follows = [
+            ViewEvent(user=e.entity_id, item=e.target_entity_id)
+            for e in PEventStore.find(
+                app_name=p.app_name, channel_name=p.channel_name,
+                entity_type="user", event_names=["follow"],
+                target_entity_type="user")
+        ]
+        return FollowTrainingData(users, follows)
+
+
+@dataclasses.dataclass
+class RecommendedUserModel:
+    """similarUserFeatures + one shared user map
+    (recommended-user ALSAlgorithm.scala:18-34)."""
+
+    similar_user_features: np.ndarray  # [N, R]
+    user_map: StringIndexBiMap
+
+    def sanity_check(self) -> None:
+        assert np.isfinite(self.similar_user_features).all()
+
+
+class RecommendedUserAlgorithm(P2LAlgorithm):
+    """Implicit ALS on follow counts over one user x user matrix; the
+    'product' factors are the followed-user features served by cosine
+    (recommended-user ALSAlgorithm.scala:44-168)."""
+
+    params_class = ALSAlgorithmParams
+    query_cls = UserQuery
+
+    def train(self, ctx: ComputeContext,
+              pd: FollowTrainingData) -> RecommendedUserModel:
+        p: ALSAlgorithmParams = self.params
+        user_map = BiMap.string_int(pd.users)
+        counts: Dict[Tuple[int, int], float] = {}
+        for f in pd.follow_events:
+            u, v = user_map.get(f.user), user_map.get(f.item)
+            if u is None or v is None:
+                continue  # follow of an un-$set user (scala :66-80)
+            counts[(u, v)] = counts.get((u, v), 0.0) + 1.0
+        n = len(user_map)
+        _, followed_factors = _factors_from_ratings(
+            counts, n, n, p,
+            "mllibRatings cannot be empty. Please check if your "
+            "events contain valid user and followedUser ID.")
+        return RecommendedUserModel(followed_factors, user_map)
+
+    def predict(self, model: RecommendedUserModel,
+                query: UserQuery) -> RecommendedUsersResult:
+        idxs = [model.user_map[u] for u in query.users
+                if u in model.user_map]
+        if not idxs:
+            return RecommendedUsersResult(())
+        winners = _cosine_topk(model.similar_user_features, idxs,
+                               query.num, model.user_map,
+                               query.white_list, query.black_list)
+        return RecommendedUsersResult(tuple(
+            SimilarUserScore(user=user, score=score)
+            for user, score, _ in winners))
+
+
 def engine_factory() -> Engine:
     """SimilarProductEngine (similarproduct Engine.scala)."""
     return Engine(
         EventDataSource,
         PIdentityPreparator,
         {"als": ALSAlgorithm, "": ALSAlgorithm},
+        LFirstServing,
+    )
+
+
+def engine_factory_recommended_user() -> Engine:
+    """RecommendedUserEngine (recommended-user Engine.scala:22-30)."""
+    return Engine(
+        FollowDataSource,
+        PIdentityPreparator,
+        {"als": RecommendedUserAlgorithm, "": RecommendedUserAlgorithm},
         LFirstServing,
     )
 

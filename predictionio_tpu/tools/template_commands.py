@@ -64,6 +64,38 @@ BUILTIN_TEMPLATES: Dict[str, Dict] = {
             }],
         },
     },
+    "similarproduct-recommended-user": {
+        "description": "Who-to-follow via ALS on follow events "
+                       "(similarproduct recommended-user variant parity)",
+        "engineFactory":
+            "predictionio_tpu.templates.similarproduct"
+            ":engine_factory_recommended_user",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.similarproduct"
+                ":engine_factory_recommended_user",
+            "datasource": {"params": {"appName": "INVALID_APP_NAME"}},
+            "algorithms": [{
+                "name": "als",
+                "params": {"rank": 10, "numIterations": 20, "seed": 3},
+            }],
+        },
+    },
+    "helloworld": {
+        "description": "L-flavor day->average-temperature engine "
+                       "(experimental/scala-local-helloworld parity)",
+        "engineFactory":
+            "predictionio_tpu.templates.helloworld:engine_factory",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.helloworld:engine_factory",
+            "datasource": {"params": {"dataPath": "data.csv"}},
+        },
+    },
     "ecommercerecommendation": {
         "description": "ALS + business-rule filters at predict time "
                        "(scala-parallel-ecommercerecommendation parity)",

@@ -303,6 +303,250 @@ class TestSimilarProductTemplate:
 # ecommercerecommendation
 # ---------------------------------------------------------------------------
 
+class TestFilterByYearVariant:
+    """filterbyyear variant: items carry a year, queries set a floor
+    (filterbyyear/src/main/scala/ALSAlgorithm.scala:225-240)."""
+
+    @pytest.fixture
+    def app(self, mem_storage):
+        aid = make_app("simapp")
+        le = storage.get_levents()
+        rng = np.random.default_rng(2)
+        events = [ev("$set", "user", f"u{u}") for u in range(10)]
+        for i in range(8):
+            events.append(ev("$set", "item", f"i{i}",
+                             props={"categories": ["film"],
+                                    "year": 1990 + i * 5}))  # 1990..2025
+        for u in range(10):
+            for _ in range(8):
+                events.append(ev("view", "user", f"u{u}", "item",
+                                 f"i{rng.integers(0, 8)}"))
+        le.insert_batch(events, aid)
+        return aid
+
+    def test_year_floor_filters_results(self, app):
+        from predictionio_tpu.templates.similarproduct import (
+            ALSAlgorithmParams, DataSourceParams, Query, engine_factory,
+        )
+
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="simapp")),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=8, num_iterations=5,
+                                           seed=0))])
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+
+        r = algo.predict(model, Query(items=("i0",), num=8,
+                                      recommend_from_year=2005))
+        assert r.item_scores
+        # i0..i3 have years 1990..2005 (floor is strict >): only i4..i7
+        assert {s.item for s in r.item_scores} <= {"i4", "i5", "i6", "i7"}
+        # results carry the item year (filterbyyear Engine.scala:19-23)
+        assert all(s.year is not None and s.year > 2005
+                   for s in r.item_scores)
+        # no floor: everything eligible again, and the BASE flavor's
+        # wire format has no year key (reference base ItemScore)
+        from predictionio_tpu.workflow.create_server import to_jsonable
+
+        r_all = algo.predict(model, Query(items=("i0",), num=8))
+        assert len(r_all.item_scores) > len(r.item_scores)
+        base_wire = to_jsonable(r_all)["itemScores"][0]
+        assert set(base_wire) == {"item", "score"}
+        year_wire = to_jsonable(r)["itemScores"][0]
+        assert set(year_wire) == {"item", "score", "year"}
+
+    def test_item_without_year_excluded_under_floor(self, app):
+        from predictionio_tpu.templates.similarproduct import (
+            ALSAlgorithmParams, DataSourceParams, Query, engine_factory,
+        )
+
+        aid = storage.get_metadata_apps().get_by_name("simapp").id
+        le = storage.get_levents()
+        le.insert_batch([ev("$set", "item", "noyear"),
+                         *[ev("view", "user", f"u{u}", "item", "noyear")
+                           for u in range(10)]], aid)
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="simapp")),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=8, num_iterations=5,
+                                           seed=0))])
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        # under ANY year floor an item without a year never recommends
+        # (the variant's `year` property is required there); even a
+        # whitelist that singles it out cannot bring it back
+        r = algo.predict(model, Query(items=("i0",), num=9,
+                                      recommend_from_year=1900))
+        assert "noyear" not in {s.item for s in r.item_scores}
+        r_white = algo.predict(model, Query(items=("i0",), num=9,
+                                            white_list=("noyear",),
+                                            recommend_from_year=1900))
+        assert r_white.item_scores == ()
+
+
+class TestRecommendedUserVariant:
+    """recommended-user variant: who-to-follow via ALS on follow events
+    (recommended-user/src/main/scala/ALSAlgorithm.scala:44-168)."""
+
+    @pytest.fixture
+    def app(self, mem_storage):
+        aid = make_app("followapp")
+        le = storage.get_levents()
+        rng = np.random.default_rng(3)
+        events = [ev("$set", "user", f"u{u}") for u in range(12)]
+        # two follow communities: 0-5 follow within 0-5, 6-11 within 6-11
+        for u in range(12):
+            lo, hi = (0, 6) if u < 6 else (6, 12)
+            for _ in range(6):
+                v = int(rng.integers(lo, hi))
+                if v != u:
+                    events.append(ev("follow", "user", f"u{u}", "user",
+                                     f"u{v}"))
+        le.insert_batch(events, aid)
+        return aid
+
+    def engine_and_params(self):
+        from predictionio_tpu.templates.similarproduct import (
+            ALSAlgorithmParams, DataSourceParams,
+            engine_factory_recommended_user,
+        )
+
+        engine = engine_factory_recommended_user()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(
+                app_name="followapp")),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=8, num_iterations=5,
+                                           seed=0))])
+        return engine, params
+
+    def test_recommends_same_community(self, app):
+        from predictionio_tpu.templates.similarproduct import UserQuery
+
+        engine, params = self.engine_and_params()
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        r = algo.predict(model, UserQuery(users=("u1",), num=3))
+        assert r.similar_user_scores
+        top = r.similar_user_scores[0]
+        assert top.user in {f"u{i}" for i in range(6)} - {"u1"}
+        assert "u1" not in {s.user for s in r.similar_user_scores}
+
+    def test_white_and_black_lists(self, app):
+        from predictionio_tpu.templates.similarproduct import UserQuery
+
+        engine, params = self.engine_and_params()
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        r = algo.predict(model, UserQuery(users=("u1",), num=8,
+                                          white_list=("u2", "u3")))
+        assert {s.user for s in r.similar_user_scores} <= {"u2", "u3"}
+        r = algo.predict(model, UserQuery(users=("u1",), num=8,
+                                          black_list=("u2",)))
+        assert "u2" not in {s.user for s in r.similar_user_scores}
+        # unknown query user -> empty (scala :133-136)
+        assert algo.predict(
+            model, UserQuery(users=("zzz",))).similar_user_scores == ()
+
+    def test_follow_of_unset_user_skipped(self, mem_storage):
+        from predictionio_tpu.templates.similarproduct import UserQuery
+
+        aid = make_app("followapp")
+        le = storage.get_levents()
+        le.insert_batch(
+            [ev("$set", "user", "u0"), ev("$set", "user", "u1"),
+             ev("$set", "user", "u2"),
+             ev("follow", "user", "u0", "user", "u1"),
+             ev("follow", "user", "u2", "user", "u1"),
+             ev("follow", "user", "u0", "user", "ghost")], aid)
+        engine, params = self.engine_and_params()
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        r = algo.predict(model, UserQuery(users=("u0",), num=5))
+        assert "ghost" not in {s.user for s in r.similar_user_scores}
+
+
+class TestHelloWorldTemplate:
+    """L-flavor template through the FULL lifecycle: train -> persist ->
+    deploy -> HTTP query (the reference's scala-local-helloworld run with
+    pio train/deploy; LDataSource/LAlgorithm end to end)."""
+
+    @pytest.fixture
+    def data_file(self, tmp_path):
+        f = tmp_path / "data.csv"
+        f.write_text("Mon,75\nTue,80\nWed,70\nThu,65\nFri,60\n"
+                     "Mon,70\nTue,70\n")
+        return str(f)
+
+    def engine_and_params(self, data_file):
+        from predictionio_tpu.templates.helloworld import (
+            DataSourceParams, engine_factory,
+        )
+
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(data_path=data_file)),
+        )
+        return engine, params
+
+    def test_train_and_predict(self, mem_storage, data_file):
+        from predictionio_tpu.templates.helloworld import Query
+
+        engine, params = self.engine_and_params(data_file)
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        assert algo.predict(model, Query(day="Mon")).temperature == 72.5
+        assert algo.predict(model, Query(day="Wed")).temperature == 70.0
+        with pytest.raises(KeyError):
+            algo.predict(model, Query(day="Sun"))
+
+    def test_full_lifecycle_through_query_server(self, mem_storage,
+                                                 data_file):
+        """train -> models repo -> deploy -> /queries.json over HTTP."""
+        import http.client
+        import json as _json
+
+        from predictionio_tpu.workflow import (
+            QueryServer, ServerConfig, run_train,
+        )
+        from predictionio_tpu.workflow.create_workflow import (
+            WorkflowConfig, new_engine_instance,
+        )
+
+        engine, params = self.engine_and_params(data_file)
+        cfg = WorkflowConfig(
+            engine_factory="predictionio_tpu.templates.helloworld"
+                           ":engine_factory")
+        iid = run_train(engine, params, new_engine_instance(cfg, params),
+                        ctx=CTX)
+        assert iid is not None
+        srv = QueryServer(ServerConfig(ip="127.0.0.1", port=0)).start(
+            undeploy_stale=False)
+        try:
+            host, port = srv.address
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            conn.request("POST", "/queries.json",
+                         body=_json.dumps({"day": "Tue"}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = _json.loads(resp.read().decode())
+            assert resp.status == 200
+            assert data["temperature"] == 75.0
+            # unknown day -> server error, not a silent empty result
+            conn.request("POST", "/queries.json",
+                         body=_json.dumps({"day": "Sun"}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 500
+            conn.close()
+        finally:
+            srv.stop()
+
+
 class TestECommerceTemplate:
     @pytest.fixture
     def app(self, mem_storage):
