@@ -164,23 +164,42 @@ def scale_ingest_bench(n_users: int = 138_000, n_items: int = 27_000,
         user_map, item_map, rows, cols, vals = builder.finalize()
         read_sec = time.perf_counter() - t0
 
+        BLOCK = 8192
         t0 = time.perf_counter()
-        us = pad_ratings(rows, cols, vals, len(user_map), len(item_map),
-                         max_len=512)
-        its = pad_ratings(cols, rows, vals, len(item_map), len(user_map),
-                          max_len=1024)
+        from predictionio_tpu.ops.als import pad_rows_to_block
+
+        # pad rows to the solve-block multiple HERE so the tables can be
+        # staged to HBM once; n_valid_rows travels with the tables, so
+        # train_als still zeroes the pad rows' init and slices them off
+        us = pad_rows_to_block(
+            pad_ratings(rows, cols, vals, len(user_map), len(item_map),
+                        max_len=512), BLOCK)
+        its = pad_rows_to_block(
+            pad_ratings(cols, rows, vals, len(item_map), len(user_map),
+                        max_len=1024), BLOCK)
         pad_sec = time.perf_counter() - t0
         processed = int(us.mask.sum() + its.mask.sum()) // 2
 
+        # stage the rating tables into HBM once (ingest transfer measured
+        # separately — over the bench harness's tunneled device this is
+        # bandwidth, not compute, and must not pollute epoch time)
+        t0 = time.perf_counter()
+        us_d, its_d = to_device(us), to_device(its)
+        for side in (us_d, its_d):
+            side.cols.block_until_ready()
+            side.weights.block_until_ready()
+            side.mask.block_until_ready()
+        h2d_sec = time.perf_counter() - t0
+
         # -- device training (row-blocked solves bound the HBM peak) -------
         params = ALSParams(rank=rank, num_iterations=iterations, seed=1,
-                           solve_block_rows=8192)
+                           solve_block_rows=BLOCK)
         t0 = time.perf_counter()
-        X, Y = train_als(us, its, params)          # includes compile + h2d
+        X, Y = train_als(us_d, its_d, params)      # includes compile
         first_sec = time.perf_counter() - t0
         assert np.isfinite(X).all() and np.isfinite(Y).all()
         t0 = time.perf_counter()
-        train_als(us, its, params)                 # steady state
+        train_als(us_d, its_d, params)             # steady state
         steady_sec = time.perf_counter() - t0
         epoch_sec = steady_sec / iterations
         return {
@@ -189,15 +208,19 @@ def scale_ingest_bench(n_users: int = 138_000, n_items: int = 27_000,
             "store_write_sec": round(write_sec, 1),
             "ingest_stream_index_sec": round(read_sec, 1),
             "ingest_pad_sec": round(pad_sec, 1),
-            "ingest_events_per_sec": round(nnz / (read_sec + pad_sec), 1),
+            "ingest_h2d_sec": round(h2d_sec, 1),
+            "ingest_events_per_sec": round(
+                nnz / (read_sec + pad_sec + h2d_sec), 1),
             "epoch_sec": round(epoch_sec, 3),
             "first_train_sec_incl_compile": round(first_sec, 1),
             "events_processed": processed,
             "events_per_sec": round(processed / epoch_sec, 1),
-            "solve_block_rows": 8192,
+            "solve_block_rows": BLOCK,
             "note": ("streamed from a partitioned JSONL store in 1M-row "
-                     "columnar blocks; max_len truncation bounds the "
-                     "power-law tail (events_processed = post-truncation)"),
+                     "columnar blocks; tables staged to HBM once "
+                     "(ingest_h2d_sec); max_len truncation bounds the "
+                     "power-law tail (events_processed = "
+                     "post-truncation)"),
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

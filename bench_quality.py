@@ -102,6 +102,11 @@ def precision_at_k(user_factors: np.ndarray, item_factors: np.ndarray,
                    held: Dict[int, set], k: int = K) -> float:
     """Mean over holdout users of |top-k unseen| ∩ held| / k — the
     template's PrecisionAtK on the model's own top-N serving logic."""
+    if not held:
+        raise ValueError(
+            "no holdout users — the (n_users, n_items, nnz) shape is too "
+            "sparse for the leave-last-out protocol (need >=5 distinct "
+            "items per user)")
     scores = user_factors @ item_factors.T
     scores[train_rows, train_cols] = -np.inf  # never recommend seen items
     users = np.fromiter(held.keys(), dtype=np.int64, count=len(held))
@@ -120,6 +125,10 @@ def popularity_precision(train_rows: np.ndarray, train_cols: np.ndarray,
     beat to demonstrate it learned anything."""
     from itertools import islice
 
+    if not held:
+        raise ValueError(
+            "no holdout users — the (n_users, n_items, nnz) shape is too "
+            "sparse for the leave-last-out protocol")
     pop_list = np.argsort(
         -np.bincount(train_cols, minlength=n_items)).tolist()
     seen: Dict[int, set] = {}

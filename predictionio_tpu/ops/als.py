@@ -74,10 +74,19 @@ class PaddedRatings:
     mask: np.ndarray      # float32 [n_rows, L]
     n_rows: int
     n_cols: int
+    # set by pad_rows_to_block: rows >= n_valid_rows are padding (their
+    # factors must be zeroed before the first shared Gram term and are
+    # sliced off the result). None = every row is real.
+    n_valid_rows: Optional[int] = None
 
     @property
     def max_len(self) -> int:
         return int(self.cols.shape[1])
+
+    @property
+    def valid_rows(self) -> int:
+        return self.n_rows if self.n_valid_rows is None \
+            else self.n_valid_rows
 
 
 def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
@@ -127,20 +136,27 @@ def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
     return PaddedRatings(out_cols, out_w, out_m, n_rows, n_cols)
 
 
-def _pad_rows(side: PaddedRatings, block: int) -> PaddedRatings:
+def pad_rows_to_block(side: PaddedRatings, block: int) -> PaddedRatings:
     """Pad the row dimension to a multiple of ``block`` with empty rows
-    (zero mask -> zero factors) for the blocked solve path. Host-side
-    numpy op: the blocked path expects host tables (it is the scale
-    ingest route; the transfer happens once inside train_als)."""
-    n = side.n_rows
-    pad = (-n) % block
+    (zero mask -> zero factors) for the blocked solve path, recording
+    the true row count in ``n_valid_rows`` so train_als zeroes the pad
+    rows' random init and slices them off the result. Host-side numpy
+    op — callers that stage tables to HBM (the scale bench) pad first,
+    then transfer once."""
+    n_valid = side.valid_rows
+    pad = (-side.n_rows) % block
     if pad == 0:
         return side
+
     def z(a):
         return np.concatenate(
             [np.asarray(a), np.zeros((pad, a.shape[1]), dtype=a.dtype)])
     return PaddedRatings(z(side.cols), z(side.weights), z(side.mask),
-                         n + pad, side.n_cols)
+                         side.n_rows + pad, side.n_cols,
+                         n_valid_rows=n_valid)
+
+
+_pad_rows = pad_rows_to_block  # private alias kept for older callers
 
 
 def transpose_ratings(pr: PaddedRatings, rows: np.ndarray, cols: np.ndarray,
@@ -303,18 +319,23 @@ def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
     """
     import jax.numpy as jnp
 
-    assert user_side.n_rows == item_side.n_cols
-    assert user_side.n_cols == item_side.n_rows
-    n_u, n_i = user_side.n_rows, user_side.n_cols
+    # >= (not ==): a pre-padded side's row count may exceed the other
+    # side's column space — indexing into the taller factor matrix is
+    # safe, its pad rows are zero
+    assert user_side.n_rows >= item_side.n_cols
+    assert item_side.n_rows >= user_side.n_cols
     block = params.solve_block_rows
     if block:
         # pad both row dims to a block multiple; extra rows have empty
-        # masks -> zero factors after their first solve
-        user_side = _pad_rows(user_side, block)
-        item_side = _pad_rows(item_side, block)
+        # masks -> zero factors after their first solve. No-ops when the
+        # caller pre-padded (e.g. to stage device tables once) — the true
+        # counts then come from n_valid_rows.
+        user_side = pad_rows_to_block(user_side, block)
+        item_side = pad_rows_to_block(item_side, block)
+    n_u, n_i = user_side.valid_rows, item_side.valid_rows
     X, Y = init_factors(user_side.n_rows, item_side.n_rows, params.rank,
                         params.seed, dtype)
-    if block:
+    if n_u < user_side.n_rows or n_i < item_side.n_rows:
         # the random init filled the pad rows too — zero them NOW, or the
         # first half-iteration's shared Gram term (Y^T Y over all rows,
         # _solve_side) would see phantom random factors

@@ -55,6 +55,16 @@ class DataSourceParams(Params):
     # multi variant: also scan like/dislike events (an extra event-store
     # pass the base ALS engine never needs)
     read_like_events: bool = False
+    # no-set-user variant: users come from the view events themselves —
+    # no $set user entities required
+    # (no-set-user/src/main/scala/ALSAlgorithm.scala:58: BiMap over
+    # viewEvents.map(_.user))
+    no_set_user: bool = False
+    # add-and-return-item-properties variant: capture title/date/imdbUrl
+    # into the model so the algorithm's return_item_properties flag can
+    # serve them; off by default so base-flavor model blobs don't carry
+    # strings they never serve
+    read_item_properties: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +73,11 @@ class Item:
     # filterbyyear variant (DataSource.scala:52/:100 there requires it;
     # merged template keeps it optional so the base flavor is unchanged)
     year: Optional[int] = None
+    # add-and-return-item-properties variant
+    # (add-and-return-item-properties/.../DataSource.scala:53-55)
+    title: str = ""
+    date: str = ""
+    imdb_url: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,6 +101,9 @@ class TrainingData:
     items: Dict[str, Item]
     view_events: List[ViewEvent]
     like_events: List[LikeEvent] = dataclasses.field(default_factory=list)
+    # True when the DataSource captured title/date/imdbUrl (the
+    # add-and-return-item-properties prerequisite)
+    item_properties_read: bool = False
 
     def sanity_check(self) -> None:
         assert self.view_events, (
@@ -125,26 +143,42 @@ class YearItemScore:
 
 
 @dataclasses.dataclass(frozen=True)
+class RichItemScore:
+    """add-and-return-item-properties' ItemScore shape (its
+    Engine.scala:18-24): results carry the stored item properties."""
+
+    item: str
+    title: str
+    date: str
+    imdb_url: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
 class PredictedResult:
     item_scores: Tuple[ItemScore, ...]
 
 
 class EventDataSource(PDataSource):
-    """$set users/items + view events (similarproduct DataSource.scala)."""
+    """$set users/items + view events (similarproduct DataSource.scala).
+    With ``no_set_user`` the user set is derived from the view events
+    instead of $set entities (no-set-user variant)."""
 
     params_class = DataSourceParams
 
     def read_training(self, ctx: ComputeContext) -> TrainingData:
         p: DataSourceParams = self.params
-        users = {
-            uid: None
-            for uid in PEventStore.aggregate_properties(
-                app_name=p.app_name, channel_name=p.channel_name,
-                entity_type="user")
-        }
+        def to_item(pm) -> Item:
+            kw = {"categories": tuple(pm.get_opt("categories", list) or ()),
+                  "year": pm.get_opt("year", int)}
+            if p.read_item_properties:
+                kw.update(title=pm.get_opt("title", str) or "",
+                          date=pm.get_opt("date", str) or "",
+                          imdb_url=pm.get_opt("imdbUrl", str) or "")
+            return Item(**kw)
+
         items = {
-            iid: Item(categories=tuple(pm.get_opt("categories", list) or ()),
-                      year=pm.get_opt("year", int))
+            iid: to_item(pm)
             for iid, pm in PEventStore.aggregate_properties(
                 app_name=p.app_name, channel_name=p.channel_name,
                 entity_type="item").items()
@@ -156,6 +190,16 @@ class EventDataSource(PDataSource):
                 entity_type="user", event_names=["view"],
                 target_entity_type="item")
         ]
+        if p.no_set_user:
+            # users are whoever viewed (no-set-user ALSAlgorithm.scala:58)
+            users = {v.user: None for v in views}
+        else:
+            users = {
+                uid: None
+                for uid in PEventStore.aggregate_properties(
+                    app_name=p.app_name, channel_name=p.channel_name,
+                    entity_type="user")
+            }
         likes: List[LikeEvent] = []
         if p.read_like_events:
             likes = [
@@ -167,7 +211,8 @@ class EventDataSource(PDataSource):
                     entity_type="user", event_names=["like", "dislike"],
                     target_entity_type="item")
             ]
-        return TrainingData(users, items, views, likes)
+        return TrainingData(users, items, views, likes,
+                            item_properties_read=p.read_item_properties)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -176,6 +221,10 @@ class ALSAlgorithmParams(Params):
     num_iterations: int = 20
     lambda_: float = 0.01
     seed: Optional[int] = None
+    # add-and-return-item-properties variant: results carry the stored
+    # item title/date/imdbUrl (RichItemScore). Ignored when a query's
+    # recommend_from_year is set (that filter returns YearItemScore).
+    return_item_properties: bool = False
 
 
 @dataclasses.dataclass
@@ -283,6 +332,14 @@ class ALSAlgorithm(P2LAlgorithm):
             if u is None or i is None:
                 continue  # view of an entity without a $set (scala :59-66)
             counts[(u, i)] = counts.get((u, i), 0.0) + 1.0
+        if getattr(p, "return_item_properties", False) \
+                and not getattr(pd, "item_properties_read", False):
+            # a mismatched flag pair would silently serve empty strings
+            raise ValueError(
+                "return_item_properties=True requires "
+                "DataSourceParams(read_item_properties=True) so the "
+                "title/date/imdbUrl properties are captured into the "
+                "model")
         return _train_item_model(counts, user_map, item_map, pd.items, p)
 
     def predict(self, model: SimilarProductModel,
@@ -319,6 +376,18 @@ class ALSAlgorithm(P2LAlgorithm):
                               year=getattr(model.items.get(ix, Item()),
                                            "year", None))
                 for item, score, ix in winners))
+        if getattr(self.params, "return_item_properties", False):
+            # add-and-return-item-properties variant (its
+            # Engine.scala:18-24); getattr guards old pickled Items
+            def rich(item, score, ix):
+                meta = model.items.get(ix, Item())
+                return RichItemScore(
+                    item=item, score=score,
+                    title=getattr(meta, "title", ""),
+                    date=getattr(meta, "date", ""),
+                    imdb_url=getattr(meta, "imdb_url", ""))
+            return PredictedResult(tuple(
+                rich(*w) for w in winners))
         return PredictedResult(tuple(
             ItemScore(item=item, score=score)
             for item, score, _ in winners))

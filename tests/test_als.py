@@ -225,6 +225,28 @@ class TestNumerics:
         rand = (X[ur] * Y[uc]).sum(axis=1).mean()
         assert obs > rand
 
+    def test_prepadded_sides_match_internal_padding(self):
+        """Callers may pad to the block multiple THEMSELVES (to stage
+        device tables once, like the scale bench) — results must be
+        identical to letting train_als pad, because n_valid_rows keeps
+        the pad-row zeroing and final slicing intact."""
+        from predictionio_tpu.ops.als import pad_rows_to_block
+
+        rows, cols, vals = synthetic_ratings(n_users=50, n_items=30,
+                                             seed=7)
+        us = pad_ratings(rows, cols, vals, 50, 30)
+        its = pad_ratings(cols, rows, vals, 30, 50)
+        params = ALSParams(rank=4, num_iterations=2, seed=3,
+                           solve_block_rows=16)
+        Xa, Ya = train_als(us, its, params)                   # internal pad
+        usp = pad_rows_to_block(us, 16)
+        itp = pad_rows_to_block(its, 16)
+        assert usp.n_valid_rows == 50 and itp.n_valid_rows == 30
+        Xb, Yb = train_als(usp, itp, params)                  # pre-padded
+        assert Xb.shape == (50, 4) and Yb.shape == (30, 4)
+        np.testing.assert_allclose(Xa, Xb, rtol=1e-6)
+        np.testing.assert_allclose(Ya, Yb, rtol=1e-6)
+
     def test_blocked_padding_rows_never_pollute_gram(self):
         """Regression: _pad_rows-added rows must enter the shared Gram
         term as ZEROS from iteration one (the random init fills them

@@ -387,6 +387,112 @@ class TestFilterByYearVariant:
         assert r_white.item_scores == ()
 
 
+class TestNoSetUserAndItemPropertiesVariants:
+    """no-set-user (users derived from view events) and
+    add-and-return-item-properties (results carry title/date/imdbUrl)."""
+
+    @pytest.fixture
+    def app(self, mem_storage):
+        aid = make_app("simapp")
+        le = storage.get_levents()
+        rng = np.random.default_rng(4)
+        events = []
+        # NOTE: no $set user events at all
+        for i in range(6):
+            events.append(ev("$set", "item", f"i{i}",
+                             props={"categories": ["film"],
+                                    "title": f"Movie {i}",
+                                    "date": f"199{i}-01-01",
+                                    "imdbUrl": f"http://imdb/{i}"}))
+        for u in range(12):
+            lo, hi = (0, 3) if u < 6 else (3, 6)
+            for _ in range(10):
+                events.append(ev("view", "user", f"u{u}", "item",
+                                 f"i{rng.integers(lo, hi)}"))
+        le.insert_batch(events, aid)
+        return aid
+
+    def test_no_set_user_trains_from_view_events(self, app):
+        from predictionio_tpu.templates.similarproduct import (
+            ALSAlgorithmParams, DataSourceParams, Query, engine_factory,
+        )
+
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(
+                app_name="simapp", no_set_user=True)),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=8,
+                                           seed=0))])
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        r = algo.predict(model, Query(items=("i0",), num=2))
+        assert r.item_scores
+        assert r.item_scores[0].item in {"i1", "i2"}
+
+    def test_without_flag_no_set_users_fails_sanity(self, app):
+        """Base flavor still REQUIRES $set users (its sanity check)."""
+        from predictionio_tpu.templates.similarproduct import (
+            ALSAlgorithmParams, DataSourceParams, engine_factory,
+        )
+
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="simapp")),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=8, num_iterations=5,
+                                           seed=0))])
+        with pytest.raises(AssertionError, match="users"):
+            engine.train(CTX, params)
+
+    def test_return_item_properties(self, app):
+        from predictionio_tpu.templates.similarproduct import (
+            ALSAlgorithmParams, DataSourceParams, Query, RichItemScore,
+            engine_factory,
+        )
+        from predictionio_tpu.workflow.create_server import to_jsonable
+
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(
+                app_name="simapp", no_set_user=True,
+                read_item_properties=True)),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=8, num_iterations=5,
+                                           seed=0,
+                                           return_item_properties=True))])
+        model = engine.train(CTX, params)[0]
+        algo = engine._algorithms(params)[0]
+        r = algo.predict(model, Query(items=("i0",), num=2))
+        assert r.item_scores
+        top = r.item_scores[0]
+        assert isinstance(top, RichItemScore)
+        n = top.item[1:]
+        assert top.title == f"Movie {n}"
+        assert top.imdb_url == f"http://imdb/{n}"
+        # wire shape matches the reference variant's ItemScore
+        wire = to_jsonable(r)["itemScores"][0]
+        assert set(wire) == {"item", "title", "date", "imdbUrl", "score"}
+
+    def test_return_without_read_flag_refused(self, app):
+        """return_item_properties without read_item_properties would
+        silently serve empty strings — refused at train."""
+        from predictionio_tpu.templates.similarproduct import (
+            ALSAlgorithmParams, DataSourceParams, engine_factory,
+        )
+
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(
+                app_name="simapp", no_set_user=True)),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=4, num_iterations=2,
+                                           seed=0,
+                                           return_item_properties=True))])
+        with pytest.raises(ValueError, match="read_item_properties"):
+            engine.train(CTX, params)
+
+
 class TestRecommendedUserVariant:
     """recommended-user variant: who-to-follow via ALS on follow events
     (recommended-user/src/main/scala/ALSAlgorithm.scala:44-168)."""
