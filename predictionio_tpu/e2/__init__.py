@@ -13,3 +13,7 @@ from predictionio_tpu.e2.engine import (  # noqa: F401
     MarkovChainModel,
 )
 from predictionio_tpu.e2.evaluation import split_data  # noqa: F401
+from predictionio_tpu.e2.forest import (  # noqa: F401
+    RandomForestModel,
+    train_classifier,
+)

@@ -11,6 +11,8 @@ from predictionio_tpu.templates.classification.engine import (  # noqa: F401
     NaiveBayesParams,
     PredictedResult,
     Query,
+    RandomForestAlgorithm,
+    RandomForestParams,
     TrainingData,
     engine_factory,
 )

@@ -19,7 +19,7 @@ add-algorithm``:
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -212,6 +212,61 @@ class CategoricalNBAlgorithm(P2LAlgorithm):
         return PredictedResult(label=float(label))
 
 
+@dataclasses.dataclass(frozen=True)
+class RandomForestParams(Params):
+    """RandomForestAlgorithmParams 1:1
+    (add-algorithm/src/main/scala/RandomForestAlgorithm.scala:12-19)."""
+
+    num_classes: int = 2
+    num_trees: int = 10
+    feature_subset_strategy: str = "auto"
+    impurity: str = "gini"
+    max_depth: int = 5
+    max_bins: int = 32
+    seed: Optional[int] = None
+
+
+class RandomForestAlgorithm(P2LAlgorithm):
+    """Random forest over the same labeled points
+    (RandomForestAlgorithm.scala:23-50; the MLlib dependency is replaced
+    by e2/forest.py's vectorized implementation)."""
+
+    params_class = RandomForestParams
+    query_cls = Query
+
+    def train(self, ctx: ComputeContext, pd: TrainingData):
+        from predictionio_tpu.e2.forest import train_classifier
+
+        p: RandomForestParams = self.params
+        X = np.asarray([lp.features for lp in pd.labeled_points],
+                       dtype=np.float64)
+        y_float = np.asarray([lp.label for lp in pd.labeled_points],
+                             dtype=np.float64)
+        y = y_float.astype(np.int64)
+        if not (y == y_float).all():
+            # int64 cast would silently truncate (e.g. label 1.5 -> 1)
+            bad = sorted(set(y_float[y != y_float].tolist()))
+            raise ValueError(
+                f"random forest labels must be integers in "
+                f"[0, num_classes); got non-integer labels {bad[:5]}")
+        return train_classifier(
+            X, y, num_classes=p.num_classes, num_trees=p.num_trees,
+            feature_subset_strategy=p.feature_subset_strategy,
+            impurity=p.impurity, max_depth=p.max_depth,
+            max_bins=p.max_bins, seed=p.seed)
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        return PredictedResult(label=model.predict(query.features))
+
+    def batch_predict(self, ctx: ComputeContext, model,
+                      indexed_queries) -> List[Tuple[int, Any]]:
+        X = np.asarray([q.features for _, q in indexed_queries],
+                       dtype=np.float64)
+        labels = model.predict_batch(X)
+        return [(qx, PredictedResult(label=float(lb)))
+                for (qx, _), lb in zip(indexed_queries, labels)]
+
+
 class Accuracy(AverageMetric):
     """Fraction of exact label matches (the template's evaluation metric)."""
 
@@ -226,6 +281,7 @@ def engine_factory() -> Engine:
         PIdentityPreparator,
         {"naive": NaiveBayesAlgorithm,
          "categorical": CategoricalNBAlgorithm,
+         "randomforest": RandomForestAlgorithm,
          "": NaiveBayesAlgorithm},
         LFirstServing,
     )

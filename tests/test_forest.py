@@ -1,0 +1,175 @@
+"""Random-forest tests: the e2 library (MLlib RandomForest.trainClassifier
+capability) and the classification template's RandomForestAlgorithm
+(add-algorithm/src/main/scala/RandomForestAlgorithm.scala)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2.forest import (
+    RandomForestModel,
+    train_classifier,
+)
+
+
+def blobs(n=300, seed=0):
+    """Two separable gaussian blobs in 3D."""
+    rng = np.random.default_rng(seed)
+    X0 = rng.normal(loc=(0, 0, 0), scale=0.7, size=(n // 2, 3))
+    X1 = rng.normal(loc=(3, 3, 0), scale=0.7, size=(n // 2, 3))
+    X = np.vstack([X0, X1])
+    y = np.asarray([0] * (n // 2) + [1] * (n // 2))
+    return X, y
+
+
+class TestForestLibrary:
+    def test_learns_separable_blobs(self):
+        X, y = blobs()
+        m = train_classifier(X, y, num_classes=2, num_trees=10,
+                             max_depth=4, seed=1)
+        acc = (m.predict_batch(X) == y).mean()
+        assert acc > 0.97
+        # single predict agrees with batch
+        assert m.predict(X[0]) == m.predict_batch(X[:1])[0]
+
+    def test_three_classes_entropy(self):
+        rng = np.random.default_rng(2)
+        X = np.vstack([rng.normal(loc=(c * 4, 0), scale=0.6, size=(60, 2))
+                       for c in range(3)])
+        y = np.repeat(np.arange(3), 60)
+        m = train_classifier(X, y, num_classes=3, num_trees=15,
+                             impurity="entropy", max_depth=4, seed=3)
+        assert (m.predict_batch(X) == y).mean() > 0.95
+
+    def test_deterministic_given_seed(self):
+        X, y = blobs(120, seed=4)
+        a = train_classifier(X, y, num_classes=2, num_trees=5, seed=9)
+        b = train_classifier(X, y, num_classes=2, num_trees=5, seed=9)
+        probe = np.random.default_rng(0).normal(size=(50, 3)) * 3
+        assert (a.predict_batch(probe) == b.predict_batch(probe)).all()
+
+    def test_max_depth_bounds_tree(self):
+        X, y = blobs(200, seed=5)
+        m = train_classifier(X, y, num_classes=2, num_trees=3,
+                             max_depth=2, seed=1)
+        # depth 2 -> at most 7 nodes per tree
+        assert all(len(t.feature) <= 7 for t in m.trees)
+
+    def test_pure_node_stops(self):
+        X = np.asarray([[0.0, 1.0]] * 10)
+        y = np.zeros(10, dtype=np.int64)  # single class: root is a leaf
+        m = train_classifier(X, y, num_classes=2, num_trees=2, seed=0)
+        assert all(len(t.feature) == 1 for t in m.trees)
+        assert m.predict([0.0, 1.0]) == 0.0
+
+    def test_validation_errors(self):
+        X, y = blobs(40)
+        with pytest.raises(ValueError, match="labels"):
+            train_classifier(X, y + 5, num_classes=2)
+        with pytest.raises(ValueError, match="impurity"):
+            train_classifier(X, y, num_classes=2, impurity="variance")
+        with pytest.raises(ValueError, match="zero samples"):
+            train_classifier(np.empty((0, 3)), np.empty(0, dtype=int),
+                             num_classes=2)
+
+    def test_feature_subset_strategies(self):
+        from predictionio_tpu.e2.forest import _n_sub_features
+
+        assert _n_sub_features("auto", 9) == 3
+        assert _n_sub_features("sqrt", 9) == 3
+        assert _n_sub_features("log2", 8) == 3
+        assert _n_sub_features("onethird", 9) == 3
+        assert _n_sub_features("all", 9) == 9
+
+    def test_max_depth_validated(self):
+        X, y = blobs(40)
+        with pytest.raises(ValueError, match="max_depth"):
+            train_classifier(X, y, num_classes=2, max_depth=100)
+
+    def test_non_integer_labels_refused_by_template(self, mem_storage):
+        from predictionio_tpu.controller import ComputeContext
+        from predictionio_tpu.templates.classification import (
+            RandomForestParams,
+        )
+        from predictionio_tpu.templates.classification.engine import (
+            LabeledPoint, RandomForestAlgorithm, TrainingData,
+        )
+
+        algo = RandomForestAlgorithm(RandomForestParams(num_classes=2))
+        td = TrainingData([LabeledPoint(label=1.5, features=(1.0, 2.0)),
+                           LabeledPoint(label=0.0, features=(0.0, 1.0))])
+        with pytest.raises(ValueError, match="non-integer labels"):
+            algo.train(ComputeContext(), td)
+
+
+class TestRandomForestTemplateAlgorithm:
+    def test_trains_and_serves_in_ensemble(self, mem_storage):
+        import datetime as dt
+
+        from predictionio_tpu.controller import (
+            ComputeContext, EngineParams,
+        )
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.templates.classification import (
+            DataSourceParams, NaiveBayesParams, Query,
+            RandomForestParams, engine_factory,
+        )
+
+        aid = storage.get_metadata_apps().insert(App(0, "clsapp"))
+        le = storage.get_levents()
+        le.init(aid)
+        rng = np.random.default_rng(0)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        events = []
+        for i in range(40):
+            label = i % 2
+            base = [1.0, 3.0, 1.0]
+            base[0 if label == 0 else 2] += 10.0 + rng.random()
+            events.append(Event(
+                event="$set", entity_type="user", entity_id=f"u{i}",
+                properties={"plan": float(label), "attr0": base[0],
+                            "attr1": base[1], "attr2": base[2]},
+                event_time=t0))
+        le.insert_batch(events, aid)
+
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="clsapp")),
+            algorithm_params_list=[
+                ("naive", NaiveBayesParams()),
+                ("randomforest", RandomForestParams(
+                    num_classes=2, num_trees=8, max_depth=4, seed=1))])
+        ctx = ComputeContext()
+        models = engine.train(ctx, params)
+        assert len(models) == 2
+        assert isinstance(models[1], RandomForestModel)
+        rf = engine._algorithms(params)[1]
+        assert rf.predict(models[1],
+                          Query(features=(12.0, 3.0, 1.0))).label == 0.0
+        assert rf.predict(models[1],
+                          Query(features=(1.0, 3.0, 12.0))).label == 1.0
+        # batch agrees with single
+        queries = [(i, Query(features=(float(f), 3.0, 5.0)))
+                   for i, f in enumerate((0.5, 12.0, 2.0))]
+        batch = dict(rf.batch_predict(ctx, models[1], queries))
+        for qx, q in queries:
+            assert batch[qx] == rf.predict(models[1], q)
+
+    def test_variant_json_binding(self, mem_storage):
+        """camelCase engine.json params bind to RandomForestParams."""
+        from predictionio_tpu.templates.classification import (
+            engine_factory,
+        )
+
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant({
+            "datasource": {"params": {"appName": "clsapp"}},
+            "algorithms": [{"name": "randomforest", "params": {
+                "numClasses": 2, "numTrees": 4,
+                "featureSubsetStrategy": "all", "impurity": "entropy",
+                "maxDepth": 3, "maxBins": 16}}],
+        })
+        (_, p) = ep.algorithm_params_list[0]
+        assert p.num_trees == 4 and p.impurity == "entropy"
+        assert p.feature_subset_strategy == "all" and p.max_bins == 16
