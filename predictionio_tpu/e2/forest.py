@@ -104,7 +104,8 @@ def _best_split(X: np.ndarray, y: np.ndarray, n_classes: int,
 
 def _n_sub_features(strategy: str, d: int) -> int:
     """MLlib featureSubsetStrategy semantics: 'auto' = sqrt for
-    classification; 'all', 'sqrt', 'log2', 'onethird' as named."""
+    classification; 'all', 'sqrt', 'log2', 'onethird' as named.
+    Unknown strategies raise, as MLlib's enum validation does."""
     s = strategy.lower()
     if s in ("auto", "sqrt"):
         return max(1, int(np.sqrt(d)))
@@ -112,7 +113,11 @@ def _n_sub_features(strategy: str, d: int) -> int:
         return max(1, int(np.log2(d)))
     if s == "onethird":
         return max(1, d // 3)
-    return d  # "all"
+    if s == "all":
+        return d
+    raise ValueError(
+        f"unsupported feature_subset_strategy {strategy!r}; use "
+        "auto|all|sqrt|log2|onethird")
 
 
 def _grow(X: np.ndarray, y: np.ndarray, n_classes: int,
@@ -197,6 +202,10 @@ def train_classifier(X: np.ndarray, y: np.ndarray, *,
         raise ValueError(f"unsupported impurity {impurity!r}")
     if not 1 <= max_depth <= 30:  # MLlib's own depth cap
         raise ValueError(f"max_depth must be in [1, 30], got {max_depth}")
+    if num_trees < 1:
+        raise ValueError(f"num_trees must be >= 1, got {num_trees}")
+    if max_bins < 2:
+        raise ValueError(f"max_bins must be >= 2, got {max_bins}")
     rng = np.random.default_rng(seed)
     n_sub = _n_sub_features(feature_subset_strategy, X.shape[1])
     trees = []

@@ -1,0 +1,13 @@
+"""Local linear-regression engine template (experimental family)."""
+
+from predictionio_tpu.templates.regression.engine import (  # noqa: F401
+    DataSourceParams,
+    LocalAlgorithm,
+    LocalDataSource,
+    LocalPreparator,
+    MeanSquareError,
+    PreparatorParams,
+    Query,
+    TrainingData,
+    engine_factory,
+)

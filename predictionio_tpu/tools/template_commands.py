@@ -96,6 +96,20 @@ BUILTIN_TEMPLATES: Dict[str, Dict] = {
             "datasource": {"params": {"dataPath": "data.csv"}},
         },
     },
+    "regression": {
+        "description": "L-flavor OLS linear regression from a data file "
+                       "(experimental/scala-local-regression parity)",
+        "engineFactory":
+            "predictionio_tpu.templates.regression:engine_factory",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.regression:engine_factory",
+            "datasource": {"params": {"filepath": "lr_data.txt"}},
+            "preparator": {"params": {"n": 0, "k": 0}},
+        },
+    },
     "ecommercerecommendation": {
         "description": "ALS + business-rule filters at predict time "
                        "(scala-parallel-ecommercerecommendation parity)",

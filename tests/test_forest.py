@@ -84,6 +84,13 @@ class TestForestLibrary:
         X, y = blobs(40)
         with pytest.raises(ValueError, match="max_depth"):
             train_classifier(X, y, num_classes=2, max_depth=100)
+        with pytest.raises(ValueError, match="num_trees"):
+            train_classifier(X, y, num_classes=2, num_trees=0)
+        with pytest.raises(ValueError, match="max_bins"):
+            train_classifier(X, y, num_classes=2, max_bins=0)
+        with pytest.raises(ValueError, match="feature_subset_strategy"):
+            train_classifier(X, y, num_classes=2,
+                             feature_subset_strategy="sqr")
 
     def test_non_integer_labels_refused_by_template(self, mem_storage):
         from predictionio_tpu.controller import ComputeContext

@@ -1,0 +1,107 @@
+"""Regression template tests (experimental scala-local-regression parity):
+OLS fit, the n/k row-dropping Preparator, MSE eval, and the full
+train->deploy->query lifecycle of a second L-flavor engine."""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import ComputeContext, EngineParams
+from predictionio_tpu.core.base import WorkflowParams
+from predictionio_tpu.templates.regression import (
+    DataSourceParams,
+    MeanSquareError,
+    PreparatorParams,
+    Query,
+    engine_factory,
+)
+
+CTX = ComputeContext()
+
+
+@pytest.fixture
+def data_file(tmp_path):
+    """y = 2*x1 - 3*x2 + 0.5*x3, tiny noise."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(80, 3))
+    y = X @ np.asarray([2.0, -3.0, 0.5]) + rng.normal(scale=0.01, size=80)
+    f = tmp_path / "lr_data.txt"
+    f.write_text("\n".join(
+        f"{yi} " + " ".join(str(v) for v in row)
+        for yi, row in zip(y, X)))
+    return str(f)
+
+
+def make_params(data_file, n=0, k=0):
+    return EngineParams(
+        data_source_params=("", DataSourceParams(filepath=data_file)),
+        preparator_params=("", PreparatorParams(n=n, k=k)),
+    )
+
+
+class TestRegression:
+    def test_recovers_coefficients(self, data_file):
+        engine = engine_factory()
+        params = make_params(data_file)
+        [model] = engine.train(CTX, params)
+        np.testing.assert_allclose(model, [2.0, -3.0, 0.5], atol=0.01)
+        algo = engine._algorithms(params)[0]
+        pred = algo.predict(model, Query(features=(1.0, 1.0, 2.0)))
+        assert abs(pred - (2.0 - 3.0 + 1.0)) < 0.05
+
+    def test_preparator_drops_rows(self, data_file):
+        engine = engine_factory()
+        params = make_params(data_file, n=2, k=0)
+        ds = engine._make(engine.data_source_class_map, "",
+                          params.data_source_params[1], "ds")
+        prep = engine._make(engine.preparator_class_map, "",
+                            params.preparator_params[1], "prep")
+        td = ds.read_training_base(CTX)
+        pd = prep.prepare_base(CTX, td)
+        assert len(pd.y) == len(td.y) // 2  # every even index dropped
+        # still fits fine on half the data
+        [model] = engine.train(CTX, params)
+        np.testing.assert_allclose(model, [2.0, -3.0, 0.5], atol=0.02)
+
+    def test_eval_mse_near_zero(self, data_file):
+        engine = engine_factory()
+        params = make_params(data_file, n=2, k=0)
+        results = engine.eval(CTX, params, WorkflowParams())
+        mse = MeanSquareError().calculate(CTX, results)
+        assert 0 <= mse < 0.01
+        # smaller error must win the tuning comparison
+        assert MeanSquareError().compare(0.001, 0.5) > 0
+
+    def test_lifecycle_through_query_server(self, mem_storage, data_file):
+        from predictionio_tpu.workflow import (
+            QueryServer, ServerConfig, run_train,
+        )
+        from predictionio_tpu.workflow.create_workflow import (
+            WorkflowConfig, new_engine_instance,
+        )
+
+        engine = engine_factory()
+        params = make_params(data_file)
+        cfg = WorkflowConfig(
+            engine_factory="predictionio_tpu.templates.regression"
+                           ":engine_factory")
+        iid = run_train(engine, params, new_engine_instance(cfg, params),
+                        ctx=CTX)
+        assert iid is not None
+        srv = QueryServer(ServerConfig(ip="127.0.0.1", port=0)).start(
+            undeploy_stale=False)
+        try:
+            host, port = srv.address
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            conn.request("POST", "/queries.json",
+                         body=json.dumps({"features": [1.0, 0.0, 0.0]}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            value = json.loads(resp.read().decode())
+            conn.close()
+            assert resp.status == 200
+            assert abs(float(value) - 2.0) < 0.05
+        finally:
+            srv.stop()
