@@ -33,16 +33,60 @@ class ColumnarEvents:
     may be None for events without a target); ``values`` is the extracted
     numeric property (``default_value`` where absent or non-numeric);
     ``event_times`` is float64 epoch seconds (UTC).
+
+    DICTIONARY-ENCODED blocks (the 10M+-event ingest fast lane from the
+    native codec): the ``*_codes``/``*_labels`` fields carry int32 codes
+    into small distinct-label tables and the object columns are None —
+    only distinct values ever become Python strings. Call
+    :meth:`materialize` for the object-array form;
+    :class:`StreamingRatingsBuilder` consumes the codes directly. A code
+    of -1 means absent (None target).
     """
 
-    entity_ids: np.ndarray   # object [n]
-    target_ids: np.ndarray   # object [n]
+    entity_ids: Optional[np.ndarray]   # object [n] (None when encoded)
+    target_ids: Optional[np.ndarray]   # object [n] (None when encoded)
     values: np.ndarray       # float32 [n]
     event_times: np.ndarray  # float64 [n] epoch seconds
     events: Optional[np.ndarray] = None  # object [n] event names (optional)
+    entity_codes: Optional[np.ndarray] = None   # int32 [n]
+    entity_labels: Optional[np.ndarray] = None  # object [k] distinct
+    target_codes: Optional[np.ndarray] = None
+    target_labels: Optional[np.ndarray] = None
+    event_codes: Optional[np.ndarray] = None
+    event_labels: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
-        return int(self.entity_ids.shape[0])
+        return int(self.values.shape[0])
+
+    @property
+    def is_encoded(self) -> bool:
+        return self.entity_codes is not None
+
+    def materialize(self) -> "ColumnarEvents":
+        """Encoded block -> object-array block (labels gathered by code;
+        -1 target codes become None)."""
+        if not self.is_encoded:
+            return self
+
+        def decode(codes, labels, none_for_missing):
+            out = np.empty(len(codes), dtype=object)
+            present = codes >= 0
+            out[present] = labels[codes[present]]
+            if none_for_missing:
+                out[~present] = None
+            return out
+
+        return ColumnarEvents(
+            entity_ids=decode(self.entity_codes, self.entity_labels,
+                              False),
+            target_ids=decode(self.target_codes, self.target_labels,
+                              True)
+            if self.target_codes is not None else self.target_ids,
+            values=self.values,
+            event_times=self.event_times,
+            events=decode(self.event_codes, self.event_labels, False)
+            if self.event_codes is not None else self.events,
+        )
 
     def encode_entities(self):
         """Vectorized dense indexing of both ID columns.
@@ -59,6 +103,8 @@ class ColumnarEvents:
         """
         from predictionio_tpu.data.bimap import StringIndexBiMap
 
+        if self.is_encoded:
+            return self.materialize().encode_entities()
         missing = np.fromiter((x is None for x in self.target_ids),
                               dtype=bool, count=len(self.target_ids))
         if missing.any():
@@ -76,24 +122,37 @@ class ColumnarEvents:
 
     def drop_missing_targets(self) -> "ColumnarEvents":
         """Rows with a target entity only (aligned across all columns)."""
+        if self.is_encoded and self.target_codes is not None:
+            return self.take(self.target_codes >= 0)
         keep = np.fromiter((x is not None for x in self.target_ids),
                            dtype=bool, count=len(self.target_ids))
         return self.take(keep)
 
     def take(self, index) -> "ColumnarEvents":
         """Aligned row selection (boolean mask, index array, or slice)."""
+        def sl(a):
+            return None if a is None else a[index]
+
         return ColumnarEvents(
-            entity_ids=self.entity_ids[index],
-            target_ids=self.target_ids[index],
+            entity_ids=sl(self.entity_ids),
+            target_ids=sl(self.target_ids),
             values=self.values[index],
             event_times=self.event_times[index],
-            events=None if self.events is None else self.events[index],
+            events=sl(self.events),
+            entity_codes=sl(self.entity_codes),
+            entity_labels=self.entity_labels,
+            target_codes=sl(self.target_codes),
+            target_labels=self.target_labels,
+            event_codes=sl(self.event_codes),
+            event_labels=self.event_labels,
         )
 
     @staticmethod
     def concat(batches: "list[ColumnarEvents]") -> "ColumnarEvents":
-        """Row-wise concatenation (events column kept only if every batch
-        has one)."""
+        """Row-wise concatenation in object-array form (encoded inputs
+        are materialized first — label tables differ across blocks);
+        events column kept only if every batch has one."""
+        batches = [b.materialize() for b in batches]
         if not batches:
             return ColumnarEvents(
                 entity_ids=np.empty(0, dtype=object),
@@ -135,17 +194,53 @@ class StreamingRatingsBuilder:
 
     def _encode(self, ids: np.ndarray, table: dict) -> np.ndarray:
         labels, inv = np.unique(ids.astype(str), return_inverse=True)
-        codes = np.empty(len(labels), dtype=np.int64)
+        return self._merge_labels(labels, table)[inv]
+
+    def _merge_labels(self, labels: np.ndarray, table: dict) -> np.ndarray:
+        """Block-local distinct labels -> global codes (the only per-item
+        Python work on the encoded path)."""
+        out = np.empty(len(labels), dtype=np.int64)
         for j, lab in enumerate(labels):
             code = table.get(lab)
             if code is None:
                 code = len(table)
                 table[lab] = code
-            codes[j] = code
-        return codes[inv]
+            out[j] = code
+        return out
 
     def add_block(self, block: ColumnarEvents) -> None:
         if not len(block):
+            return
+        if block.is_encoded:
+            # dictionary-encoded block (native-codec fast lane): remap
+            # the block's small label tables into the global dicts and
+            # gather — zero per-event Python objects. Only labels a KEPT
+            # row actually references are registered: a part's label
+            # table spans the whole file, and upstream filters must not
+            # leak phantom entities into the maps.
+            ecodes = block.entity_codes
+            tcodes = block.target_codes
+            if (ecodes < 0).any():
+                raise ValueError(
+                    f"{int((ecodes < 0).sum())} events have no entity id; "
+                    "filter the scan (e.g. by entity_type) before "
+                    "streaming ingest")
+            keep = tcodes >= 0
+            if not keep.all():
+                ecodes, tcodes = ecodes[keep], tcodes[keep]
+                vals = np.asarray(block.values, dtype=np.float32)[keep]
+            else:
+                vals = np.asarray(block.values, dtype=np.float32)
+            if not len(ecodes):
+                return
+            uniq_e, inv_e = np.unique(ecodes, return_inverse=True)
+            uniq_t, inv_t = np.unique(tcodes, return_inverse=True)
+            self._rows.append(self._merge_labels(
+                block.entity_labels[uniq_e], self._users)[inv_e])
+            self._cols.append(self._merge_labels(
+                block.target_labels[uniq_t], self._items)[inv_t])
+            self._vals.append(vals)
+            self.n_events += len(ecodes)
             return
         # same guard as TrainingData/encode_entities: a None entity id
         # must never become the literal string "None" and train a

@@ -64,9 +64,14 @@ class ParsedEvents:
     # 2 = present but non-numeric
     prop_value: Optional[np.ndarray] = None   # float64
     prop_status: Optional[np.ndarray] = None  # uint8
+    # dictionary encodings (ingest fast lane), when requested:
+    # col id -> (codes int32 [n], first-seen distinct labels). A code of
+    # -1 means the column is absent on that row.
+    dict_codes: Optional[dict] = None
+    dict_labels: Optional[dict] = None
 
     def __len__(self) -> int:
-        return len(self.event)
+        return len(self.lineno)
 
 
 def _lib():
@@ -102,6 +107,19 @@ def _lib():
         lib.pio_jsonl_extract_numeric.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_uint8)]
+        lib.pio_jsonl_dict_encode.restype = ctypes.c_void_p
+        lib.pio_jsonl_dict_encode.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int32]
+        lib.pio_dict_n_labels.restype = ctypes.c_int64
+        lib.pio_dict_n_labels.argtypes = [ctypes.c_void_p]
+        lib.pio_dict_blob_bytes.restype = ctypes.c_int64
+        lib.pio_dict_blob_bytes.argtypes = [ctypes.c_void_p]
+        lib.pio_dict_fill.restype = None
+        lib.pio_dict_fill.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+        lib.pio_dict_free.restype = None
+        lib.pio_dict_free.argtypes = [ctypes.c_void_p]
         lib._pio_sigs = True
     return lib
 
@@ -137,9 +155,33 @@ def _col(lib, handle, col: int, n: int) -> List[Optional[str]]:
     return out
 
 
+def _dict_encode(lib, handle, col: int, n: int):
+    """C++ dictionary encoding of one string column: int32 codes per row
+    plus the distinct labels (only DISTINCT values ever become Python
+    strings — the 10M-row ingest fast lane)."""
+    d = lib.pio_jsonl_dict_encode(handle, col)
+    try:
+        k = lib.pio_dict_n_labels(d)
+        nbytes = lib.pio_dict_blob_bytes(d)
+        codes = np.empty(n, dtype=np.int32)
+        blob = ctypes.create_string_buffer(max(1, nbytes))
+        offsets = np.empty(k + 1, dtype=np.int64)
+        lib.pio_dict_fill(
+            d, codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        raw = blob.raw[:nbytes]
+        labels = np.empty(k, dtype=object)
+        for i in range(k):
+            labels[i] = raw[offsets[i]:offsets[i + 1]].decode("utf-8")
+        return codes, labels
+    finally:
+        lib.pio_dict_free(d)
+
+
 def parse_jsonl(data: bytes,
                 numeric_property: Optional[str] = None,
-                columns: Optional[set] = None
+                columns: Optional[set] = None,
+                dict_encode: Optional[set] = None
                 ) -> Optional[ParsedEvents]:
     """Parse a JSON-lines event buffer natively; None if the native lib
     is unavailable (callers use the pure-python path then).
@@ -151,15 +193,23 @@ def parse_jsonl(data: bytes,
     ``columns`` (COL_* ids) restricts which string columns are
     materialized as Python lists — the per-row str construction is the
     dominant decode cost, so bulk-ingest callers fetch only what they
-    read; excluded columns are ``None`` on the result."""
+    read; excluded columns are ``None`` on the result.
+
+    ``dict_encode`` (COL_* ids) returns those columns as int32 codes +
+    distinct labels instead of per-row strings (``dict_codes``/
+    ``dict_labels``). With ``columns=None`` every NON-encoded column is
+    still materialized; an encoded column is additionally materialized
+    only if explicitly listed in ``columns``."""
     lib = _lib()
     if lib is None:
         return None
     handle = lib.pio_jsonl_parse(data, len(data))
     try:
         n = lib.pio_jsonl_count(handle)
+        enc = dict_encode or set()
         cols = [_col(lib, handle, c, n)
-                if columns is None or c in columns else None
+                if (c in columns if columns is not None else c not in enc)
+                else None
                 for c in range(12)]
         et = np.empty(n, dtype=np.float64)
         ct = np.empty(n, dtype=np.float64)
@@ -201,6 +251,12 @@ def parse_jsonl(data: bytes,
                 ps.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
             parsed.prop_value = pv
             parsed.prop_status = ps
+        if enc:
+            parsed.dict_codes, parsed.dict_labels = {}, {}
+            for c in enc:
+                codes, labels = _dict_encode(lib, handle, c, n)
+                parsed.dict_codes[c] = codes
+                parsed.dict_labels[c] = labels
         return parsed
     finally:
         lib.pio_jsonl_free(handle)

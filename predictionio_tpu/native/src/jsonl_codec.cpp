@@ -16,6 +16,8 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <string_view>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -615,6 +617,69 @@ void pio_jsonl_lines(void* h, int64_t* start, int64_t* end, int64_t* lineno) {
 }
 
 void pio_jsonl_free(void* h) { delete static_cast<Result*>(h); }
+
+// Dictionary-encode one string column: per-row int32 codes in
+// first-seen label order (-1 where the column is absent) plus the
+// distinct label blob. This is the ingest fast lane that lets training
+// reads skip materializing one Python string per row — at 10M+ events
+// the per-row str construction dominates the whole read.
+struct DictResult {
+  std::vector<int32_t> codes;
+  std::string blob;               // concatenated distinct labels
+  std::vector<int64_t> offsets;   // label k -> [offsets[k], offsets[k+1])
+};
+
+void* pio_jsonl_dict_encode(void* h, int32_t col) {
+  Result* r = static_cast<Result*>(h);
+  const Col& c = r->cols[col];
+  auto* d = new DictResult();
+  d->codes.resize(static_cast<size_t>(r->n));
+  d->offsets.push_back(0);
+  std::unordered_map<std::string_view, int32_t> map;
+  map.reserve(1024);
+  for (int64_t i = 0; i < r->n; ++i) {
+    if (!c.present[static_cast<size_t>(i)]) {
+      d->codes[static_cast<size_t>(i)] = -1;
+      continue;
+    }
+    std::string_view sv(
+        c.data.data() + c.offsets[static_cast<size_t>(i)],
+        static_cast<size_t>(c.offsets[static_cast<size_t>(i) + 1] -
+                            c.offsets[static_cast<size_t>(i)]));
+    auto it = map.find(sv);
+    int32_t code;
+    if (it == map.end()) {
+      code = static_cast<int32_t>(map.size());
+      map.emplace(sv, code);
+      d->blob.append(sv);
+      d->offsets.push_back(static_cast<int64_t>(d->blob.size()));
+    } else {
+      code = it->second;
+    }
+    d->codes[static_cast<size_t>(i)] = code;
+  }
+  return d;
+}
+
+int64_t pio_dict_n_labels(void* d) {
+  return static_cast<int64_t>(
+      static_cast<DictResult*>(d)->offsets.size() - 1);
+}
+
+int64_t pio_dict_blob_bytes(void* d) {
+  return static_cast<int64_t>(static_cast<DictResult*>(d)->blob.size());
+}
+
+void pio_dict_fill(void* dh, int32_t* codes, char* blob, int64_t* offsets) {
+  DictResult* d = static_cast<DictResult*>(dh);
+  if (!d->codes.empty())
+    std::memcpy(codes, d->codes.data(), d->codes.size() * sizeof(int32_t));
+  if (!d->blob.empty()) std::memcpy(blob, d->blob.data(), d->blob.size());
+  std::memcpy(offsets, d->offsets.data(),
+              d->offsets.size() * sizeof(int64_t));
+}
+
+void pio_dict_free(void* d) { delete static_cast<DictResult*>(d); }
 
 // Extract one top-level numeric property per row from the raw
 // `properties` slices — the training-ingest value column (e.g. "rating")

@@ -168,6 +168,58 @@ class TestBlocks:
         assert [len(b) for b in blocks] == [10, 10, 5]
 
 
+class TestEncodedBlocks:
+    """The dictionary-encoded fast lane: jsonlfs blocks carry int32
+    codes + distinct labels, zero per-event Python strings."""
+
+    def test_blocks_are_encoded_and_materialize_to_oracle(self, store):
+        blocks = list(store.find_columnar_blocks(
+            APP, value_property="rating", block_size=10))
+        assert all(b.is_encoded for b in blocks)
+        assert all(b.entity_ids is None for b in blocks)
+        whole = ColumnarEvents.concat(blocks)  # materializes
+        want = store.find_columnar(APP, value_property="rating")
+        assert sorted(zip(whole.entity_ids.tolist(),
+                          whole.target_ids.tolist(),
+                          whole.values.tolist())) == \
+            sorted(zip(want.entity_ids.tolist(),
+                       want.target_ids.tolist(),
+                       want.values.tolist()))
+
+    def test_encoded_filters_match_object_path(self, store):
+        enc = ColumnarEvents.concat(list(store.find_columnar_blocks(
+            APP, event_names=["rate"], entity_type="user",
+            target_entity_type="item", value_property="rating",
+            block_size=9)))
+        assert len(enc) == 20
+        assert set(enc.events.tolist()) == {"rate"}
+
+    def test_missing_target_code_is_none_after_materialize(self, tmp_path):
+        pe = JsonlFsPEvents({"path": str(tmp_path / "ev")})
+        pe._l.init(APP)
+        pe._l.insert_batch(
+            [Event(event="$set", entity_type="user", entity_id="u1",
+                   properties={"x": 1}, event_time=t(0)),
+             Event(event="rate", entity_type="user", entity_id="u1",
+                   target_entity_type="item", target_entity_id="i1",
+                   properties={"rating": 3}, event_time=t(1))], APP)
+        [block] = list(pe.find_columnar_blocks(APP))
+        assert block.is_encoded
+        mat = block.materialize()
+        assert mat.target_ids.tolist() == [None, "i1"]
+        dropped = block.drop_missing_targets()
+        assert len(dropped) == 1
+
+    def test_encode_entities_on_encoded_block(self, store):
+        blocks = list(store.find_columnar_blocks(
+            APP, event_names=["rate"], target_entity_type="item",
+            block_size=100))
+        block = next(b for b in blocks if len(b))
+        umap, imap, rows, cols = block.encode_entities()
+        assert len(rows) == len(block)
+        assert set(umap.decode(rows)) <= {"u0", "u1", "u2"}
+
+
 class TestStreamingBuilder:
     def test_matches_single_scan_encoding(self, store):
         """Blocks through the incremental indexer == one-shot
@@ -191,6 +243,33 @@ class TestStreamingBuilder:
                              whole.target_ids.tolist(),
                              whole.values.tolist()))
         assert streamed == scanned
+
+    def test_filtered_rows_never_register_phantom_entities(self, tmp_path):
+        """A part's label table spans the WHOLE file; rows dropped by a
+        filter must not leak their entities into the builder maps
+        (regression: encoded-path label merge)."""
+        from predictionio_tpu.data.columnar import StreamingRatingsBuilder
+
+        pe = JsonlFsPEvents({"path": str(tmp_path / "ev")})
+        pe._l.init(APP)
+        pe._l.insert_batch(
+            [Event(event="rate", entity_type="user", entity_id="u1",
+                   target_entity_type="item", target_entity_id="i1",
+                   properties={"rating": 3}, event_time=t(0)),
+             Event(event="view", entity_type="user", entity_id="ghost",
+                   target_entity_type="item", target_entity_id="phantom",
+                   event_time=t(1)),
+             Event(event="$set", entity_type="user", entity_id="setter",
+                   properties={"x": 1}, event_time=t(2))], APP)
+        b = StreamingRatingsBuilder()
+        for block in pe.find_columnar_blocks(
+                APP, event_names=["rate"], target_entity_type="item",
+                value_property="rating"):
+            b.add_block(block)
+        user_map, item_map, rows, cols, vals = b.finalize()
+        assert user_map.labels.tolist() == ["u1"]
+        assert item_map.labels.tolist() == ["i1"]
+        assert len(rows) == 1
 
     def test_drops_rows_without_target(self):
         from predictionio_tpu.data.columnar import (
