@@ -218,14 +218,18 @@ class JsonlFsPEvents(base.LEventsBackedPEvents):
         for part in lev._parts(d):
             with open(part, "rb") as f:
                 data = f.read()
-            block = self._decode_part(
-                data, start_time=start_time, until_time=until_time,
-                entity_type=entity_type, event_names=event_names,
-                target_entity_type=target_entity_type,
-                value_property=value_property, default_value=default_value,
-                strict=strict, source=part)
-            for i in range(0, len(block), block_size):
-                yield block.take(slice(i, i + block_size))
+            # a part may yield TWO blocks: the (encoded) bulk of the
+            # file plus a small object-form block of fallback rows — one
+            # exotic line must not de-optimize the whole partition
+            for block in self._decode_part(
+                    data, start_time=start_time, until_time=until_time,
+                    entity_type=entity_type, event_names=event_names,
+                    target_entity_type=target_entity_type,
+                    value_property=value_property,
+                    default_value=default_value,
+                    strict=strict, source=part):
+                for i in range(0, len(block), block_size):
+                    yield block.take(slice(i, i + block_size))
 
     def find_columnar(self, app_id, channel_id=None, start_time=None,
                       until_time=None, entity_type=None, event_names=None,
@@ -248,10 +252,12 @@ class JsonlFsPEvents(base.LEventsBackedPEvents):
     def _decode_part(self, data: bytes, *, start_time, until_time,
                      entity_type, event_names, target_entity_type,
                      value_property, default_value, strict, source: str):
-        """bytes -> filtered ColumnarEvents, native codec first. The
-        string columns come back DICTIONARY-ENCODED (int32 codes +
+        """bytes -> list of filtered ColumnarEvents, native codec first.
+        The string columns come back DICTIONARY-ENCODED (int32 codes +
         distinct labels), so filtering is pure numpy over codes and no
-        per-event Python strings exist — the 10M-row fast lane."""
+        per-event Python strings exist — the 10M-row fast lane. Fallback
+        rows (lines the codec punted on) come back as a separate small
+        object-form block so they never de-optimize the encoded bulk."""
         from predictionio_tpu.data.columnar import (
             ColumnarEvents,
             events_to_columnar,
@@ -279,9 +285,9 @@ class JsonlFsPEvents(base.LEventsBackedPEvents):
                     if match_event(e, start_time, until_time, entity_type,
                                    None, event_names, target_entity_type,
                                    UNSET)]
-            return events_to_columnar(kept, value_property=value_property,
-                                      default_value=default_value,
-                                      strict=strict)
+            return [events_to_columnar(kept, value_property=value_property,
+                                       default_value=default_value,
+                                       strict=strict)]
 
         flags = parsed.flags
         keep = (flags & codec.FALLBACK) == 0
@@ -351,7 +357,9 @@ class JsonlFsPEvents(base.LEventsBackedPEvents):
             event_labels=parsed.dict_labels[codec.COL_EVENT],
         )
 
+        out = [block]
         # fallback rows: the python oracle re-parses those exact lines
+        # into their own small block
         fb_rows = np.nonzero((flags & codec.FALLBACK) != 0)[0]
         if len(fb_rows):
             events = []
@@ -364,8 +372,7 @@ class JsonlFsPEvents(base.LEventsBackedPEvents):
                                UNSET):
                     events.append(e)
             if events:
-                extra = events_to_columnar(
+                out.append(events_to_columnar(
                     events, value_property=value_property,
-                    default_value=default_value, strict=strict)
-                block = ColumnarEvents.concat([block, extra])
-        return block
+                    default_value=default_value, strict=strict))
+        return out

@@ -273,20 +273,17 @@ def _train_item_model(ratings: Dict[Tuple[int, int], float],
     return SimilarProductModel(item_factors, item_map, items)
 
 
-def _cosine_topk(features: np.ndarray, idxs: List[int], num: int,
+def _filter_topk(scores: np.ndarray, idxs: List[int], num: int,
                  id_map: StringIndexBiMap,
                  white_list: Tuple[str, ...],
                  black_list: Tuple[str, ...],
                  extra_mask: Optional[np.ndarray] = None
                  ) -> List[Tuple[str, float, int]]:
-    """The candidate-filter + top-k shared by every cosine-serving flavor
+    """The candidate-filter + top-k shared by every score-serving flavor
     (isCandidateItem / isCandidateSimilarUser in the reference variants):
-    summed cosine scores of the query rows against all rows, keep
-    positive scores, drop the query rows themselves, apply
+    keep positive scores, drop the query rows themselves, apply
     white/black lists (and any variant-specific ``extra_mask``), return
     ``(decoded id, score, row index)`` descending."""
-    qf = features[np.asarray(idxs, dtype=np.int64)]
-    scores = cosine_scores(qf, features)
     scores = np.where(np.isfinite(scores), scores, 0.0)
     mask = scores > 0
     mask[np.asarray(idxs, dtype=np.int64)] = False
@@ -311,6 +308,33 @@ def _cosine_topk(features: np.ndarray, idxs: List[int], num: int,
     decoded = id_map.decode(top)
     return [(str(d), float(scores[ix]), int(ix))
             for d, ix in zip(decoded, top)]
+
+
+def _category_mask(items: Dict[int, Item], n: int,
+                   categories: Tuple[str, ...]) -> np.ndarray:
+    """Candidate mask for the category-intersection rule shared by every
+    similarproduct flavor (isCandidateItem's categories clause): items
+    without an overlapping category — or without metadata — are out."""
+    mask = np.zeros(n, dtype=bool)
+    cats = set(categories)
+    for ix, item in items.items():
+        if cats.intersection(item.categories):
+            mask[ix] = True
+    return mask
+
+
+def _cosine_topk(features: np.ndarray, idxs: List[int], num: int,
+                 id_map: StringIndexBiMap,
+                 white_list: Tuple[str, ...],
+                 black_list: Tuple[str, ...],
+                 extra_mask: Optional[np.ndarray] = None
+                 ) -> List[Tuple[str, float, int]]:
+    """Summed cosine scores of the query rows against all rows, then the
+    shared candidate filter + top-k."""
+    qf = features[np.asarray(idxs, dtype=np.int64)]
+    scores = cosine_scores(qf, features)
+    return _filter_topk(scores, idxs, num, id_map, white_list, black_list,
+                        extra_mask)
 
 
 class ALSAlgorithm(P2LAlgorithm):
@@ -351,16 +375,15 @@ class ALSAlgorithm(P2LAlgorithm):
         extra = None
         year_filter = query.recommend_from_year is not None
         if query.categories or year_filter:
-            extra = np.ones(model.product_features.shape[0], dtype=bool)
-            cats = set(query.categories)
-            for ix, item in model.items.items():
-                if cats and not cats.intersection(item.categories):
-                    extra[ix] = False
+            n = model.product_features.shape[0]
+            extra = (_category_mask(model.items, n, query.categories)
+                     if query.categories else np.ones(n, dtype=bool))
+            if year_filter:
                 # year floor (filterbyyear ALSAlgorithm.scala:231): items
                 # without a year never recommend under this filter,
                 # matching the variant's required `year` property. Old
                 # pickled models may predate the field -> getattr.
-                if year_filter:
+                for ix, item in model.items.items():
                     year = getattr(item, "year", None)
                     if year is None or year <= query.recommend_from_year:
                         extra[ix] = False
@@ -419,6 +442,96 @@ class LikeAlgorithm(ALSAlgorithm):
         ratings = {k: (1.0 if like else -1.0)
                    for k, (like, _) in latest.items()}
         return _train_item_model(ratings, user_map, item_map, pd.items, p)
+
+
+@dataclasses.dataclass(frozen=True)
+class DIMSUMAlgorithmParams(Params):
+    """DIMSUMAlgorithmParams (experimental similarproduct-dimsum,
+    ``DIMSUMAlgorithm.scala:23``): similarities below ``threshold`` are
+    dropped. Spark's columnSimilarities(threshold) SAMPLES to
+    approximate high-similarity pairs cheaply; one device matmul
+    computes them exactly here, so the threshold is an exact cut."""
+
+    threshold: float = 0.0
+
+
+@dataclasses.dataclass
+class DIMSUMModel:
+    """Item-item cosine similarity matrix + maps + item metadata
+    (DIMSUMModel, ``DIMSUMAlgorithm.scala:25-52`` — the RDD of sparse
+    similarity vectors becomes one dense [M, M] float32 table; item
+    vocabularies at this template's scale fit comfortably)."""
+
+    similarities: np.ndarray          # [M, M] float32, zero diagonal
+    item_map: StringIndexBiMap
+    items: Dict[int, Item]
+
+    def sanity_check(self) -> None:
+        assert np.isfinite(self.similarities).all()
+
+
+class DIMSUMAlgorithm(P2LAlgorithm):
+    """Item-to-item cosine similarity computed DIRECTLY from the binary
+    user x item view matrix — no factorization
+    (``DIMSUMAlgorithm.scala:72-140``: RowMatrix.columnSimilarities).
+    TPU-native: column-normalize the interaction matrix and take one
+    A^T A matmul on the MXU instead of Spark's sampled shuffle."""
+
+    params_class = DIMSUMAlgorithmParams
+    query_cls = Query
+
+    def train(self, ctx: ComputeContext,
+              pd: TrainingData) -> DIMSUMModel:
+        import jax
+        import jax.numpy as jnp
+
+        p: DIMSUMAlgorithmParams = self.params
+        user_map = BiMap.string_int(pd.users)
+        item_map = BiMap.string_int(pd.items)
+        n_u, n_i = len(user_map), len(item_map)
+        # binary de-duplicated (user, item) matrix ("keep one copy",
+        # DIMSUMAlgorithm.scala:104-115)
+        pairs = {(user_map[v.user], item_map[v.item])
+                 for v in pd.view_events
+                 if v.user in user_map and v.item in item_map}
+        if not pairs:
+            raise ValueError(
+                "viewEvents produced no valid (user, item) pairs. Please "
+                "check if your events contain valid user and item ID.")
+        A = np.zeros((n_u, n_i), dtype=np.float32)
+        keys = np.asarray(list(pairs), dtype=np.int64)
+        A[keys[:, 0], keys[:, 1]] = 1.0
+
+        @jax.jit
+        def column_similarities(A):
+            norms = jnp.maximum(jnp.linalg.norm(A, axis=0), 1e-12)
+            An = A / norms[None, :]
+            S = jnp.matmul(An.T, An,
+                           precision=jax.lax.Precision.HIGHEST)
+            S = S * (1.0 - jnp.eye(S.shape[0], dtype=S.dtype))
+            return jnp.where(S >= p.threshold, S, 0.0)
+
+        sims = np.asarray(column_similarities(jnp.asarray(A)))
+        items = {item_map[iid]: item for iid, item in pd.items.items()}
+        return DIMSUMModel(sims, item_map, items)
+
+    def predict(self, model: DIMSUMModel, query: Query) -> PredictedResult:
+        idxs = [model.item_map[i] for i in query.items
+                if i in model.item_map]
+        if not idxs:
+            return PredictedResult(())
+        # sum the query items' similarity rows (DIMSUMAlgorithm.scala:
+        # 153-180 flatMap + groupBy-sum), then the shared filters
+        scores = model.similarities[np.asarray(idxs, dtype=np.int64)] \
+            .sum(axis=0)
+        extra = (_category_mask(model.items, len(scores),
+                                query.categories)
+                 if query.categories else None)
+        winners = _filter_topk(scores, idxs, query.num, model.item_map,
+                               query.white_list, query.black_list, extra)
+        return PredictedResult(tuple(
+            ItemScore(item=item, score=score)
+            for item, score, _ in winners))
 
 
 class MultiServing(LServing):
@@ -581,6 +694,18 @@ def engine_factory_recommended_user() -> Engine:
         FollowDataSource,
         PIdentityPreparator,
         {"als": RecommendedUserAlgorithm, "": RecommendedUserAlgorithm},
+        LFirstServing,
+    )
+
+
+def engine_factory_dimsum() -> Engine:
+    """DIMSUM variant: similarities from the raw interaction matrix
+    instead of factors (experimental scala-parallel-similarproduct-dimsum
+    Engine.scala)."""
+    return Engine(
+        EventDataSource,
+        PIdentityPreparator,
+        {"dimsum": DIMSUMAlgorithm, "": DIMSUMAlgorithm},
         LFirstServing,
     )
 

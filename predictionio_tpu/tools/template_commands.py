@@ -96,6 +96,23 @@ BUILTIN_TEMPLATES: Dict[str, Dict] = {
             "datasource": {"params": {"dataPath": "data.csv"}},
         },
     },
+    "similarproduct-dimsum": {
+        "description": "Item-item cosine from the raw interaction matrix "
+                       "(experimental similarproduct-dimsum parity)",
+        "engineFactory":
+            "predictionio_tpu.templates.similarproduct"
+            ":engine_factory_dimsum",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.similarproduct"
+                ":engine_factory_dimsum",
+            "datasource": {"params": {"appName": "INVALID_APP_NAME"}},
+            "algorithms": [{"name": "dimsum",
+                            "params": {"threshold": 0.1}}],
+        },
+    },
     "regression": {
         "description": "L-flavor OLS linear regression from a data file "
                        "(experimental/scala-local-regression parity)",

@@ -13,6 +13,7 @@ from predictionio_tpu.data.storage.jsonlfs import (
     JsonlFsLEvents,
     JsonlFsPEvents,
 )
+from predictionio_tpu.native import codec
 
 UTC = dt.timezone.utc
 APP = 1
@@ -171,6 +172,10 @@ class TestBlocks:
 class TestEncodedBlocks:
     """The dictionary-encoded fast lane: jsonlfs blocks carry int32
     codes + distinct labels, zero per-event Python strings."""
+
+    pytestmark = pytest.mark.skipif(
+        not codec.is_available(),
+        reason="native codec unavailable (encoded fast lane inactive)")
 
     def test_blocks_are_encoded_and_materialize_to_oracle(self, store):
         blocks = list(store.find_columnar_blocks(

@@ -303,6 +303,89 @@ class TestSimilarProductTemplate:
 # ecommercerecommendation
 # ---------------------------------------------------------------------------
 
+class TestDIMSUMVariant:
+    """DIMSUM variant: item-item cosine straight from the interaction
+    matrix — no factorization (experimental similarproduct-dimsum,
+    DIMSUMAlgorithm.scala:72-180)."""
+
+    @pytest.fixture
+    def app(self, mem_storage):
+        aid = make_app("simapp")
+        le = storage.get_levents()
+        rng = np.random.default_rng(6)
+        events = [ev("$set", "user", f"u{u}") for u in range(12)]
+        for i in range(8):
+            cat = "electronics" if i < 4 else "books"
+            events.append(ev("$set", "item", f"i{i}",
+                             props={"categories": [cat]}))
+        for u in range(12):
+            lo, hi = (0, 4) if u < 6 else (4, 8)
+            for _ in range(6):
+                events.append(ev("view", "user", f"u{u}", "item",
+                                 f"i{rng.integers(lo, hi)}"))
+        le.insert_batch(events, aid)
+        return aid
+
+    def engine_and_params(self, threshold=0.0):
+        from predictionio_tpu.templates.similarproduct import (
+            DataSourceParams, DIMSUMAlgorithmParams, engine_factory_dimsum,
+        )
+
+        engine = engine_factory_dimsum()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="simapp")),
+            algorithm_params_list=[
+                ("dimsum", DIMSUMAlgorithmParams(threshold=threshold))])
+        return engine, params
+
+    def test_exact_cosine_vs_numpy_oracle(self, app):
+        from predictionio_tpu.templates.similarproduct import (
+            DIMSUMModel,
+        )
+
+        engine, params = self.engine_and_params()
+        [model] = engine.train(CTX, params)
+        assert isinstance(model, DIMSUMModel)
+        # oracle: rebuild the dedup binary matrix host-side
+        from predictionio_tpu.data import storage as st
+
+        aid = st.get_metadata_apps().get_by_name("simapp").id
+        pairs = {(e.entity_id, e.target_entity_id)
+                 for e in st.get_levents().find(
+                     app_id=aid, event_names=["view"])}
+        users = sorted({u for u, _ in pairs})
+        A = np.zeros((len(users), 8), dtype=np.float64)
+        uix = {u: i for i, u in enumerate(users)}
+        for u, i in pairs:
+            A[uix[u], model.item_map[i]] = 1.0
+        An = A / np.maximum(np.linalg.norm(A, axis=0), 1e-12)
+        S = An.T @ An
+        np.fill_diagonal(S, 0.0)
+        np.testing.assert_allclose(model.similarities, S, atol=1e-5)
+
+    def test_similar_items_same_group(self, app):
+        from predictionio_tpu.templates.similarproduct import Query
+
+        engine, params = self.engine_and_params()
+        [model] = engine.train(CTX, params)
+        algo = engine._algorithms(params)[0]
+        r = algo.predict(model, Query(items=("i0",), num=3))
+        assert r.item_scores
+        assert r.item_scores[0].item in {"i1", "i2", "i3"}
+        assert "i0" not in {s.item for s in r.item_scores}
+        # filters shared with the ALS flavor
+        rb = algo.predict(model, Query(items=("i0",), num=8,
+                                       categories=("books",)))
+        assert all(s.item in {"i4", "i5", "i6", "i7"}
+                   for s in rb.item_scores)
+
+    def test_threshold_cuts_similarities(self, app):
+        engine, params = self.engine_and_params(threshold=0.9)
+        [model] = engine.train(CTX, params)
+        nz = model.similarities[model.similarities > 0]
+        assert (nz >= 0.9).all()
+
+
 class TestFilterByYearVariant:
     """filterbyyear variant: items carry a year, queries set a floor
     (filterbyyear/src/main/scala/ALSAlgorithm.scala:225-240)."""
