@@ -93,6 +93,21 @@ class LEvents(abc.ABC):
         reference).
         """
 
+    def delete_until(self, app_id: int, until_time: _dt.datetime,
+                     channel_id: Optional[int] = None) -> int:
+        """Bulk-remove every event with event_time < until_time; returns
+        the count removed. This is the cleanup-app capability
+        (``examples/experimental/scala-cleanup-app/.../DataSource.scala``
+        deletes pre-cutoff events one futureDelete at a time); backends
+        override with single-pass bulk paths."""
+        ids = [e.event_id for e in self.find(
+            app_id=app_id, channel_id=channel_id, until_time=until_time)]
+        n = 0
+        for eid in ids:
+            if eid and self.delete(eid, app_id, channel_id):
+                n += 1
+        return n
+
     def aggregate_properties(
         self,
         app_id: int,

@@ -181,6 +181,60 @@ class JsonlFsLEvents(base.LEvents):
                     return True
         return False
 
+    def delete_until(self, app_id, until_time, channel_id=None) -> int:
+        """Rewrite each partition keeping only post-cutoff lines (the
+        native codec supplies per-line times + byte spans, so surviving
+        lines are copied verbatim without re-serialization)."""
+        from predictionio_tpu.native import codec
+
+        d = self._dir(app_id, channel_id)
+        cutoff = until_time.timestamp()
+        removed = 0
+        with self._lock:
+            for part in self._parts(d):
+                with open(part, "rb") as f:
+                    data = f.read()
+                parsed = codec.parse_jsonl(data, columns=set())
+                if parsed is None:
+                    kept, dropped = self._filter_lines_python(data, cutoff)
+                else:
+                    times = parsed.event_time.copy()
+                    for i in np.nonzero(np.isnan(times))[0]:
+                        raw = data[parsed.line_start[i]:
+                                   parsed.line_end[i]].decode(
+                            "utf-8", errors="replace").strip()
+                        times[i] = Event.from_json(raw) \
+                            .event_time.timestamp()
+                    keep = times >= cutoff
+                    kept = [data[parsed.line_start[i]:parsed.line_end[i]]
+                            for i in np.nonzero(keep)[0]]
+                    dropped = int((~keep).sum())
+                if dropped:
+                    # atomic replace: a crash mid-rewrite must never lose
+                    # the surviving (post-cutoff) events
+                    tmp = part + ".tmp"
+                    with open(tmp, "wb") as f:
+                        if kept:
+                            f.write(b"\n".join(kept))
+                            f.write(b"\n")
+                    os.replace(tmp, part)
+                    removed += dropped
+            self._writers.pop(d, None)  # recount on next append
+        return removed
+
+    def _filter_lines_python(self, data: bytes, cutoff: float):
+        kept: List[bytes] = []
+        dropped = 0
+        for line in data.split(b"\n"):
+            if not line.strip():
+                continue
+            e = Event.from_json(line.decode("utf-8", errors="replace"))
+            if e.event_time.timestamp() >= cutoff:
+                kept.append(line)
+            else:
+                dropped += 1
+        return kept, dropped
+
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,
              entity_type=None, entity_id=None, event_names=None,
              target_entity_type=UNSET, target_entity_id=UNSET,

@@ -413,6 +413,15 @@ class SqliteLEvents(base.LEvents):
                 (int(app_id), self._chan(channel_id), event_id))
             return cur.rowcount > 0
 
+    def delete_until(self, app_id, until_time, channel_id=None) -> int:
+        """One DELETE statement instead of the per-event loop."""
+        with self._client.tx() as c:
+            cur = c.execute(
+                "DELETE FROM events WHERE app_id=? AND channel_id=? AND "
+                "event_time<?",
+                (int(app_id), self._chan(channel_id), _ts(until_time)))
+            return int(cur.rowcount)
+
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,
              entity_type=None, entity_id=None, event_names=None,
              target_entity_type=UNSET, target_entity_id=UNSET,

@@ -25,12 +25,18 @@ def dispatch(args) -> int:
         return app_delete(args.name, args.force)
     if cmd == "data-delete":
         return app_data_delete(args.name, args.channel, args.force)
+    if cmd == "data-cleanup":
+        return app_data_cleanup(args.name, args.before, args.channel,
+                                args.force)
+    if cmd == "data-trim":
+        return app_data_trim(args.name, args.dst, args.start, args.until,
+                             args.channel, args.dst_channel)
     if cmd == "channel-new":
         return app_channel_new(args.name, args.channel)
     if cmd == "channel-delete":
         return app_channel_delete(args.name, args.channel, args.force)
-    print("usage: pio app {new,list,show,delete,data-delete,channel-new,"
-          "channel-delete} ...", file=sys.stderr)
+    print("usage: pio app {new,list,show,delete,data-delete,data-cleanup,"
+          "data-trim,channel-new,channel-delete} ...", file=sys.stderr)
     return 2
 
 
@@ -123,15 +129,9 @@ def app_data_delete(name: str, channel=None, force: bool = False) -> int:
         print(f"[ERROR] App {name} does not exist. Aborting.",
               file=sys.stderr)
         return 1
-    channel_id = None
-    if channel is not None:
-        match = next((c for c in storage.get_metadata_channels()
-                      .get_by_appid(app.id) if c.name == channel), None)
-        if match is None:
-            print(f"[ERROR] Channel {channel} does not exist. Aborting.",
-                  file=sys.stderr)
-            return 1
-        channel_id = match.id
+    channel_id, rc = _resolve_channel(app, channel)
+    if rc:
+        return rc
     if not force and not _confirm(
             f"Delete all event data of app {name}"
             + (f" channel {channel}" if channel else "") + "?"):
@@ -141,6 +141,94 @@ def app_data_delete(name: str, channel=None, force: bool = False) -> int:
     levents.remove(app.id, channel_id)
     levents.init(app.id, channel_id)  # wipe + reinit (App.scala data-delete)
     print(f"[INFO] Removed event data of app: {name}")
+    return 0
+
+
+def _resolve_channel(app, channel):
+    """(channel_id, error_rc): None channel -> default channel."""
+    if channel is None:
+        return None, None
+    match = next((c for c in storage.get_metadata_channels()
+                  .get_by_appid(app.id) if c.name == channel), None)
+    if match is None:
+        print(f"[ERROR] Channel {channel} does not exist. Aborting.",
+              file=sys.stderr)
+        return None, 1
+    return match.id, None
+
+
+def app_data_cleanup(name: str, before: str, channel=None,
+                     force: bool = False) -> int:
+    """Delete events older than a cutoff time — the experimental
+    cleanup-app capability (``examples/experimental/scala-cleanup-app/
+    .../DataSource.scala``) as a first-class verb instead of a fake
+    engine run."""
+    from predictionio_tpu.data.event import _parse_time
+
+    apps = storage.get_metadata_apps()
+    app = apps.get_by_name(name)
+    if app is None:
+        print(f"[ERROR] App {name} does not exist. Aborting.",
+              file=sys.stderr)
+        return 1
+    channel_id, rc = _resolve_channel(app, channel)
+    if rc:
+        return rc
+    try:
+        cutoff = _parse_time(before)
+    except Exception as e:
+        print(f"[ERROR] Bad --before time {before!r}: {e}", file=sys.stderr)
+        return 1
+    if cutoff is None:
+        print("[ERROR] --before time is required.", file=sys.stderr)
+        return 1
+    if not force and not _confirm(
+            f"Delete all events of app {name} before {cutoff.isoformat()}?"):
+        print("[INFO] Aborted.")
+        return 0
+    # no pre-count scan: at 10M+ events a typed full scan would cost more
+    # than the cleanup itself; delete_until reports what it removed
+    removed = storage.get_levents().delete_until(app.id, cutoff, channel_id)
+    print(f"[INFO] Removed {removed} events before {cutoff.isoformat()}.")
+    return 0
+
+
+def app_data_trim(src: str, dst: str, start=None, until=None,
+                  src_channel=None, dst_channel=None) -> int:
+    """Copy a time window of events from one app to another — the
+    experimental trim-app capability (``examples/experimental/
+    scala-parallel-trim-app/.../DataSource.scala``: src window ->
+    dst app, event IDs preserved)."""
+    from predictionio_tpu.data.event import _parse_time
+
+    apps = storage.get_metadata_apps()
+    src_app = apps.get_by_name(src)
+    dst_app = apps.get_by_name(dst)
+    for label, app in (("Source", src_app), ("Destination", dst_app)):
+        if app is None:
+            print(f"[ERROR] {label} app does not exist. Aborting.",
+                  file=sys.stderr)
+            return 1
+    src_cid, rc = _resolve_channel(src_app, src_channel)
+    if rc:
+        return rc
+    dst_cid, rc = _resolve_channel(dst_app, dst_channel)
+    if rc:
+        return rc
+    try:
+        start_t = _parse_time(start) if start else None
+        until_t = _parse_time(until) if until else None
+    except Exception as e:
+        print(f"[ERROR] Bad time bound: {e}", file=sys.stderr)
+        return 1
+    levents = storage.get_levents()
+    events = list(levents.find(app_id=src_app.id, channel_id=src_cid,
+                               start_time=start_t, until_time=until_t))
+    levents.init(dst_app.id, dst_cid)
+    BATCH = 5000
+    for i in range(0, len(events), BATCH):
+        levents.insert_batch(events[i:i + BATCH], dst_app.id, dst_cid)
+    print(f"[INFO] Copied {len(events)} events from app {src} to {dst}.")
     return 0
 
 
@@ -182,18 +270,15 @@ def app_channel_delete(name: str, channel: str, force: bool = False) -> int:
         print(f"[ERROR] App {name} does not exist. Aborting.",
               file=sys.stderr)
         return 1
-    match = next((c for c in storage.get_metadata_channels()
-                  .get_by_appid(app.id) if c.name == channel), None)
-    if match is None:
-        print(f"[ERROR] Channel {channel} does not exist. Aborting.",
-              file=sys.stderr)
-        return 1
+    channel_id, rc = _resolve_channel(app, channel)
+    if rc or channel_id is None:
+        return rc or 1
     if not force and not _confirm(
             f"Delete channel {channel} of app {name} and ALL its data?"):
         print("[INFO] Aborted.")
         return 0
-    storage.get_levents().remove(app.id, match.id)
-    storage.get_metadata_channels().delete(match.id)
+    storage.get_levents().remove(app.id, channel_id)
+    storage.get_metadata_channels().delete(channel_id)
     print(f"[INFO] Channel {channel} deleted.")
     return 0
 

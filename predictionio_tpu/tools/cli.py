@@ -115,6 +115,22 @@ def build_parser() -> argparse.ArgumentParser:
     dd.add_argument("name")
     dd.add_argument("--channel", default=None)
     dd.add_argument("-f", "--force", action="store_true")
+    dc = app_sub.add_parser("data-cleanup",
+                            help="delete events older than a cutoff time")
+    dc.add_argument("name")
+    dc.add_argument("--before", required=True,
+                    help="ISO-8601 cutoff; events before it are deleted")
+    dc.add_argument("--channel", default=None)
+    dc.add_argument("-f", "--force", action="store_true")
+    dtr = app_sub.add_parser("data-trim",
+                             help="copy a time window of events to "
+                                  "another app")
+    dtr.add_argument("name", help="source app")
+    dtr.add_argument("--dst", required=True, help="destination app")
+    dtr.add_argument("--start", default=None, help="ISO-8601 window start")
+    dtr.add_argument("--until", default=None, help="ISO-8601 window end")
+    dtr.add_argument("--channel", default=None, help="source channel")
+    dtr.add_argument("--dst-channel", default=None)
     cn = app_sub.add_parser("channel-new", help="create a channel")
     cn.add_argument("name")
     cn.add_argument("channel")

@@ -49,6 +49,53 @@ class TestCli:
         assert storage.get_metadata_apps().get_by_name("myapp") is None
         assert storage.get_metadata_access_keys().get_by_appid(app.id) == []
 
+    def test_app_data_cleanup_and_trim(self, mem_storage, capsys):
+        """data-cleanup deletes pre-cutoff events (cleanup-app parity);
+        data-trim copies a time window to another app (trim-app parity)."""
+        import datetime as dt
+
+        from predictionio_tpu.data.event import Event
+
+        UTC = dt.timezone.utc
+        main(["app", "new", "srcapp"])
+        main(["app", "new", "dstapp"])
+        src = storage.get_metadata_apps().get_by_name("srcapp")
+        dst = storage.get_metadata_apps().get_by_name("dstapp")
+        le = storage.get_levents()
+        for i in range(6):
+            le.insert(Event(event="rate", entity_type="user",
+                            entity_id=f"u{i}", target_entity_type="item",
+                            target_entity_id="i1",
+                            event_time=dt.datetime(2022, 1, 1 + i,
+                                                   tzinfo=UTC)), src.id)
+        capsys.readouterr()
+
+        # trim the middle window into dstapp first
+        assert main(["app", "data-trim", "srcapp", "--dst", "dstapp",
+                     "--start", "2022-01-02T00:00:00+00:00",
+                     "--until", "2022-01-05T00:00:00+00:00"]) == 0
+        assert "Copied 3 events" in capsys.readouterr().out
+        copied = list(le.find(dst.id))
+        assert len(copied) == 3
+        assert {e.entity_id for e in copied} == {"u1", "u2", "u3"}
+
+        # cleanup everything before Jan 4 in the source
+        assert main(["app", "data-cleanup", "srcapp", "-f",
+                     "--before", "2022-01-04T00:00:00+00:00"]) == 0
+        out = capsys.readouterr().out
+        assert "Removed 3 events" in out
+        rest = list(le.find(src.id))
+        assert {e.entity_id for e in rest} == {"u3", "u4", "u5"}
+        # destination untouched by the source cleanup
+        assert len(list(le.find(dst.id))) == 3
+
+        # error paths
+        assert main(["app", "data-cleanup", "nope", "-f",
+                     "--before", "2022-01-01T00:00:00+00:00"]) == 1
+        assert main(["app", "data-cleanup", "srcapp", "-f",
+                     "--before", "garbage"]) == 1
+        assert main(["app", "data-trim", "srcapp", "--dst", "nope"]) == 1
+
     def test_channel_lifecycle(self, mem_storage, capsys):
         main(["app", "new", "chanapp"])
         assert main(["app", "channel-new", "chanapp", "weblogs"]) == 0

@@ -154,6 +154,28 @@ class TestLEvents:
         assert len(list(le.find(APP))) == 3
         assert le.get(ids[0], APP) is not None
 
+    def test_delete_until(self, backend):
+        """Bulk pre-cutoff removal (cleanup-app capability) across every
+        backend: events before the cutoff go, the rest stay readable,
+        channel isolation holds."""
+        le = backend["levents"]
+        le.init(APP)
+        le.init(APP, 0)
+        le.insert_batch([mk(i) for i in range(6)], APP)       # t(0)..t(5)
+        le.insert(mk(1), APP, 0)  # other channel, pre-cutoff
+        removed = le.delete_until(APP, t(3), None)
+        assert removed == 3
+        rest = list(le.find(APP))
+        assert len(rest) == 3
+        assert min(e.event_time for e in rest) == t(3)
+        # the other channel was untouched
+        assert len(list(le.find(APP, channel_id=0))) == 1
+        # idempotent: nothing left before the cutoff
+        assert le.delete_until(APP, t(3), None) == 0
+        # appends after a cleanup still work (jsonlfs writer recount)
+        le.insert(mk(9), APP)
+        assert len(list(le.find(APP))) == 4
+
     def test_aggregate_properties(self, backend):
         le = backend["levents"]
         le.init(APP)
