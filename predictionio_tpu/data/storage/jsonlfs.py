@@ -29,6 +29,8 @@ Contracts:
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import glob
 import os
 import shutil
@@ -70,6 +72,22 @@ class JsonlFsLEvents(base.LEvents):
 
     def _parts(self, d: str) -> List[str]:
         return sorted(glob.glob(os.path.join(d, "part-*.jsonl")))
+
+    @contextlib.contextmanager
+    def _dir_lock(self, d: str):
+        """CROSS-PROCESS mutual exclusion for one app/channel directory:
+        an advisory flock on ``<dir>/.lock`` taken around every append
+        and every partition rewrite, so a CLI cleanup racing a live
+        eventserver's appends (separate processes — the in-process RLock
+        cannot see them) can never drop freshly appended lines."""
+        os.makedirs(d, exist_ok=True)
+        with self._lock:
+            with open(os.path.join(d, ".lock"), "a") as lf:
+                fcntl.flock(lf.fileno(), fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lf.fileno(), fcntl.LOCK_UN)
 
     def _writer_state(self, d: str) -> list:
         st = self._writers.get(d)
@@ -128,8 +146,7 @@ class JsonlFsLEvents(base.LEvents):
         partition rolling — the bulk-import path."""
         lines = list(lines)
         d = self._dir(app_id, channel_id)
-        os.makedirs(d, exist_ok=True)
-        with self._lock:
+        with self._dir_lock(d):
             st = self._writer_state(d)
             pos = 0
             while pos < len(lines):
@@ -167,7 +184,7 @@ class JsonlFsLEvents(base.LEvents):
                channel_id: Optional[int] = None) -> bool:
         d = self._dir(app_id, channel_id)
         needle = f'"{event_id}"'
-        with self._lock:
+        with self._dir_lock(d):
             for part in self._parts(d):
                 with open(part, "r", encoding="utf-8") as f:
                     lines = f.readlines()
@@ -190,7 +207,7 @@ class JsonlFsLEvents(base.LEvents):
         d = self._dir(app_id, channel_id)
         cutoff = until_time.timestamp()
         removed = 0
-        with self._lock:
+        with self._dir_lock(d):
             for part in self._parts(d):
                 with open(part, "rb") as f:
                     data = f.read()

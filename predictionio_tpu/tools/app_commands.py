@@ -221,14 +221,32 @@ def app_data_trim(src: str, dst: str, start=None, until=None,
     except Exception as e:
         print(f"[ERROR] Bad time bound: {e}", file=sys.stderr)
         return 1
+    from itertools import islice
+
     levents = storage.get_levents()
-    events = list(levents.find(app_id=src_app.id, channel_id=src_cid,
-                               start_time=start_t, until_time=until_t))
     levents.init(dst_app.id, dst_cid)
+    # idempotent re-runs: events keep their IDs, and append-only backends
+    # (jsonlfs) would otherwise duplicate them on a retry
+    existing = {e.event_id for e in levents.find(app_id=dst_app.id,
+                                                 channel_id=dst_cid)}
+    # stream the source window in bounded chunks — never one full list
+    it = iter(levents.find(app_id=src_app.id, channel_id=src_cid,
+                           start_time=start_t, until_time=until_t))
     BATCH = 5000
-    for i in range(0, len(events), BATCH):
-        levents.insert_batch(events[i:i + BATCH], dst_app.id, dst_cid)
-    print(f"[INFO] Copied {len(events)} events from app {src} to {dst}.")
+    copied = skipped = 0
+    while True:
+        chunk = [e for e in islice(it, BATCH)]
+        if not chunk:
+            break
+        fresh = [e for e in chunk if e.event_id not in existing]
+        skipped += len(chunk) - len(fresh)
+        if fresh:
+            levents.insert_batch(fresh, dst_app.id, dst_cid)
+            copied += len(fresh)
+    msg = f"[INFO] Copied {copied} events from app {src} to {dst}."
+    if skipped:
+        msg += f" ({skipped} already present, skipped)"
+    print(msg)
     return 0
 
 

@@ -79,6 +79,13 @@ class TestCli:
         assert len(copied) == 3
         assert {e.entity_id for e in copied} == {"u1", "u2", "u3"}
 
+        # idempotent: a retry copies nothing new (ids already present)
+        assert main(["app", "data-trim", "srcapp", "--dst", "dstapp",
+                     "--start", "2022-01-02T00:00:00+00:00",
+                     "--until", "2022-01-05T00:00:00+00:00"]) == 0
+        assert "Copied 0 events" in capsys.readouterr().out
+        assert len(list(le.find(dst.id))) == 3
+
         # cleanup everything before Jan 4 in the source
         assert main(["app", "data-cleanup", "srcapp", "-f",
                      "--before", "2022-01-04T00:00:00+00:00"]) == 0
