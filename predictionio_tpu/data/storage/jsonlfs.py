@@ -62,7 +62,8 @@ class JsonlFsLEvents(base.LEvents):
                                      DEFAULT_PART_MAX_EVENTS))
         # dir -> [last_part_index, events_in_last_part]
         self._writers: dict = {}
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()          # guards dicts only
+        self._dir_tlocks: dict = {}             # dir -> threading.RLock
 
     # -- layout -----------------------------------------------------------
 
@@ -75,13 +76,17 @@ class JsonlFsLEvents(base.LEvents):
 
     @contextlib.contextmanager
     def _dir_lock(self, d: str):
-        """CROSS-PROCESS mutual exclusion for one app/channel directory:
-        an advisory flock on ``<dir>/.lock`` taken around every append
-        and every partition rewrite, so a CLI cleanup racing a live
-        eventserver's appends (separate processes — the in-process RLock
-        cannot see them) can never drop freshly appended lines."""
-        os.makedirs(d, exist_ok=True)
+        """Mutual exclusion for one app/channel directory, across
+        threads (per-directory RLock) AND processes (advisory flock on
+        ``<dir>/.lock``), taken around every append and every partition
+        rewrite so a CLI cleanup racing a live eventserver's appends can
+        never drop freshly appended lines. The process-global ``_lock``
+        is held only for dict access — one directory's long rewrite
+        must not stall writes to other apps."""
         with self._lock:
+            tlock = self._dir_tlocks.setdefault(d, threading.RLock())
+        with tlock:
+            os.makedirs(d, exist_ok=True)
             with open(os.path.join(d, ".lock"), "a") as lf:
                 fcntl.flock(lf.fileno(), fcntl.LOCK_EX)
                 try:
@@ -112,12 +117,13 @@ class JsonlFsLEvents(base.LEvents):
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         d = self._dir(app_id, channel_id)
-        with self._lock:
-            self._writers.pop(d, None)
-            if os.path.isdir(d):
-                shutil.rmtree(d)
-                return True
-        return False
+        if not os.path.isdir(d):
+            return False
+        with self._dir_lock(d):
+            with self._lock:
+                self._writers.pop(d, None)
+            shutil.rmtree(d, ignore_errors=True)
+        return True
 
     def close(self) -> None:
         pass
@@ -147,7 +153,8 @@ class JsonlFsLEvents(base.LEvents):
         lines = list(lines)
         d = self._dir(app_id, channel_id)
         with self._dir_lock(d):
-            st = self._writer_state(d)
+            with self._lock:
+                st = self._writer_state(d)
             pos = 0
             while pos < len(lines):
                 if st[1] >= self._part_max:
@@ -183,6 +190,8 @@ class JsonlFsLEvents(base.LEvents):
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
         d = self._dir(app_id, channel_id)
+        if not os.path.isdir(d):  # nothing to delete; don't create dirs
+            return False
         needle = f'"{event_id}"'
         with self._dir_lock(d):
             for part in self._parts(d):
@@ -194,7 +203,8 @@ class JsonlFsLEvents(base.LEvents):
                 if len(kept) != len(lines):
                     with open(part, "w", encoding="utf-8") as f:
                         f.writelines(kept)
-                    self._writers.pop(d, None)  # recount on next append
+                    with self._lock:
+                        self._writers.pop(d, None)  # recount on append
                     return True
         return False
 
@@ -205,6 +215,8 @@ class JsonlFsLEvents(base.LEvents):
         from predictionio_tpu.native import codec
 
         d = self._dir(app_id, channel_id)
+        if not os.path.isdir(d):  # nothing to clean; don't create dirs
+            return 0
         cutoff = until_time.timestamp()
         removed = 0
         with self._dir_lock(d):
@@ -236,7 +248,8 @@ class JsonlFsLEvents(base.LEvents):
                             f.write(b"\n")
                     os.replace(tmp, part)
                     removed += dropped
-            self._writers.pop(d, None)  # recount on next append
+            with self._lock:
+                self._writers.pop(d, None)  # recount on next append
         return removed
 
     def _filter_lines_python(self, data: bytes, cutoff: float):

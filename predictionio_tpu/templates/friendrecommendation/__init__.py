@@ -1,0 +1,15 @@
+"""Friend-recommendation engine template (KDD Cup 2012 track 1 shape)."""
+
+from predictionio_tpu.templates.friendrecommendation.engine import (  # noqa: F401,E501
+    DataSourceParams,
+    FriendRecommendationDataSource,
+    KeywordSimilarityAlgorithm,
+    KeywordSimilarityModel,
+    Prediction,
+    Query,
+    RandomAlgorithm,
+    RandomModel,
+    TrainingData,
+    engine_factory,
+    engine_factory_random,
+)

@@ -229,7 +229,9 @@ def app_data_trim(src: str, dst: str, start=None, until=None,
     # (jsonlfs) would otherwise duplicate them on a retry
     existing = {e.event_id for e in levents.find(app_id=dst_app.id,
                                                  channel_id=dst_cid)}
-    # stream the source window in bounded chunks — never one full list
+    # insert in bounded chunks (read-side memory depends on the
+    # backend's find(): sqlite streams, jsonlfs materializes the
+    # time-ordered window)
     it = iter(levents.find(app_id=src_app.id, channel_id=src_cid,
                            start_time=start_t, until_time=until_t))
     BATCH = 5000
@@ -238,7 +240,13 @@ def app_data_trim(src: str, dst: str, start=None, until=None,
         chunk = [e for e in islice(it, BATCH)]
         if not chunk:
             break
-        fresh = [e for e in chunk if e.event_id not in existing]
+        fresh = []
+        for e in chunk:
+            # `existing` also absorbs ids copied THIS run, so duplicate
+            # ids inside the source window copy exactly once
+            if e.event_id not in existing:
+                existing.add(e.event_id)
+                fresh.append(e)
         skipped += len(chunk) - len(fresh)
         if fresh:
             levents.insert_batch(fresh, dst_app.id, dst_cid)

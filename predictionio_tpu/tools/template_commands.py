@@ -96,6 +96,25 @@ BUILTIN_TEMPLATES: Dict[str, Dict] = {
             "datasource": {"params": {"dataPath": "data.csv"}},
         },
     },
+    "friendrecommendation": {
+        "description": "Keyword-similarity friend/item acceptance on KDD "
+                       "Cup 2012 data (experimental "
+                       "scala-local-friend-recommendation parity)",
+        "engineFactory":
+            "predictionio_tpu.templates.friendrecommendation"
+            ":engine_factory",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.friendrecommendation"
+                ":engine_factory",
+            "datasource": {"params": {
+                "itemFilePath": "data/item.txt",
+                "userKeywordFilePath": "data/user_key_word.txt",
+                "userActionFilePath": "data/user_action.txt"}},
+        },
+    },
     "similarproduct-dimsum": {
         "description": "Item-item cosine from the raw interaction matrix "
                        "(experimental similarproduct-dimsum parity)",
