@@ -95,19 +95,23 @@ class JsonlFsLEvents(base.LEvents):
                     fcntl.flock(lf.fileno(), fcntl.LOCK_UN)
 
     def _writer_state(self, d: str) -> list:
-        st = self._writers.get(d)
-        if st is None:
-            parts = self._parts(d)
-            if parts:
-                idx = int(os.path.basename(parts[-1])[5:-6])
-                with open(parts[-1], "rb") as f:
-                    cnt = sum(chunk.count(b"\n") for chunk in
-                              iter(lambda: f.read(1 << 20), b""))
-            else:
-                idx, cnt = 0, 0
-            st = [idx, cnt]
-            self._writers[d] = st
-        return st
+        """Caller must hold the DIRECTORY lock; the global ``_lock`` is
+        only taken around dict access, so the (possibly large) partition
+        recount never stalls writes to other apps."""
+        with self._lock:
+            st = self._writers.get(d)
+        if st is not None:
+            return st
+        parts = self._parts(d)
+        if parts:
+            idx = int(os.path.basename(parts[-1])[5:-6])
+            with open(parts[-1], "rb") as f:
+                cnt = sum(chunk.count(b"\n") for chunk in
+                          iter(lambda: f.read(1 << 20), b""))
+        else:
+            idx, cnt = 0, 0
+        with self._lock:
+            return self._writers.setdefault(d, [idx, cnt])
 
     # -- lifecycle --------------------------------------------------------
 
@@ -122,7 +126,10 @@ class JsonlFsLEvents(base.LEvents):
         with self._dir_lock(d):
             with self._lock:
                 self._writers.pop(d, None)
-            shutil.rmtree(d, ignore_errors=True)
+            # let a failed deletion RAISE (a silent True would report
+            # data deleted while partitions remain on disk); the .lock
+            # file itself is part of the tree and goes with it
+            shutil.rmtree(d)
         return True
 
     def close(self) -> None:
@@ -153,8 +160,7 @@ class JsonlFsLEvents(base.LEvents):
         lines = list(lines)
         d = self._dir(app_id, channel_id)
         with self._dir_lock(d):
-            with self._lock:
-                st = self._writer_state(d)
+            st = self._writer_state(d)
             pos = 0
             while pos < len(lines):
                 if st[1] >= self._part_max:

@@ -30,6 +30,7 @@ from predictionio_tpu.controller import (
     EngineParamsGenerator,
     Evaluation,
     LFirstServing,
+    LServing,
     OptionAverageMetric,
     P2LAlgorithm,
     PAlgorithm,
@@ -58,6 +59,10 @@ class DataSourceParams(Params):
     event_names: Tuple[str, ...] = ("rate",)
     channel_name: Optional[str] = None
     streaming_block_size: Optional[int] = None
+    # filter-by-category variant: also aggregate item $set categories so
+    # queries can restrict recommendations to categories
+    # (filter-by-category/.../DataSource.scala:60-79)
+    read_item_categories: bool = False
 
 
 @dataclasses.dataclass
@@ -93,6 +98,7 @@ class TrainingData:
             raise ValueError(
                 f"misaligned rating columns: {len(self.users)} users, "
                 f"{len(self.items)} items, {len(self.values)} values")
+        self.item_categories: Optional[Dict[str, Tuple[str, ...]]] = None
         # a None id would become the literal string 'None' at indexing time
         # and train a phantom row/column (cf. ColumnarEvents.encode_entities)
         for name, col in (("user", self.users), ("item", self.items)):
@@ -135,6 +141,7 @@ class IndexedTrainingData:
         self.rows = rows
         self.cols = cols
         self.values = values
+        self.item_categories: Optional[Dict[str, Tuple[str, ...]]] = None
 
     def __len__(self) -> int:
         return int(self.rows.shape[0])
@@ -173,7 +180,9 @@ class EventDataSource(PDataSource):
                     default_value=1.0,
                     block_size=int(p.streaming_block_size)):
                 builder.add_block(block)
-            return IndexedTrainingData(*builder.finalize())
+            td = IndexedTrainingData(*builder.finalize())
+            td.item_categories = self._read_item_categories(p)
+            return td
         batch = PEventStore.find_columnar(
             app_name=p.app_name,
             channel_name=p.channel_name,
@@ -183,8 +192,23 @@ class EventDataSource(PDataSource):
             value_property="rating",
             default_value=1.0,
         )
-        return TrainingData(users=batch.entity_ids, items=batch.target_ids,
-                            values=batch.values)
+        td = TrainingData(users=batch.entity_ids, items=batch.target_ids,
+                          values=batch.values)
+        td.item_categories = self._read_item_categories(p)
+        return td
+
+    @staticmethod
+    def _read_item_categories(p: DataSourceParams):
+        """$set item categories (filter-by-category DataSource.scala:
+        60-79); None when the variant flag is off."""
+        if not p.read_item_categories:
+            return None
+        return {
+            iid: tuple(pm.get_opt("categories", list) or ())
+            for iid, pm in PEventStore.aggregate_properties(
+                app_name=p.app_name, channel_name=p.channel_name,
+                entity_type="item").items()
+        }
 
     def read_eval(self, ctx: ComputeContext):
         """k-fold style eval: hold out every k-th rating per user as the
@@ -224,6 +248,9 @@ class Query:
     items: Tuple[str, ...] = ()
     num: int = 10
     blacklist: Tuple[str, ...] = ()
+    # filter-by-category variant: only items in these categories
+    # (filter-by-category/.../Engine.scala query field)
+    categories: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -254,6 +281,8 @@ class PreparedData:
     user_side: PaddedRatings
     item_side: PaddedRatings
     seen: Dict[int, np.ndarray]  # user idx -> item idx array (for blacklist)
+    # filter-by-category variant: item idx -> categories (None = unread)
+    item_categories: Optional[Dict[int, Tuple[str, ...]]] = None
 
     def sanity_check(self) -> None:
         assert self.user_side.n_rows > 0, "no users after indexing"
@@ -305,7 +334,13 @@ class RatingsPreparator(PPreparator):
         starts = np.searchsorted(s_rows, np.arange(n_u))
         ends = np.searchsorted(s_rows, np.arange(n_u), side="right")
         seen = {u: s_cols[starts[u]:ends[u]] for u in range(n_u)}
-        return PreparedData(user_map, item_map, user_side, item_side, seen)
+        cats = None
+        raw_cats = getattr(td, "item_categories", None)
+        if raw_cats is not None:
+            cats = {item_map[iid]: tuple(c)
+                    for iid, c in raw_cats.items() if iid in item_map}
+        return PreparedData(user_map, item_map, user_side, item_side, seen,
+                            item_categories=cats)
 
 
 class _DeviceServedModel:
@@ -323,6 +358,9 @@ class _DeviceServedModel:
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_server"] = None  # device handles don't pickle
+        # derived caches rebuild on demand; keep model blobs lean
+        state.pop("_cat_index", None)
+        state.pop("_cat_black_cache", None)
         return state
 
 
@@ -339,6 +377,7 @@ class ALSModel(_DeviceServedModel):
     user_map: StringIndexBiMap
     item_map: StringIndexBiMap
     seen: Dict[int, np.ndarray]
+    item_categories: Optional[Dict[int, Tuple[str, ...]]] = None
     _server: Any = dataclasses.field(default=None, repr=False, compare=False)
 
     def _make_server(self):
@@ -361,7 +400,8 @@ def _coerce_query(query: Any) -> Query:
         return Query(user=query.get("user"),
                      items=tuple(query.get("items", ())),
                      num=int(query.get("num", 10)),
-                     blacklist=tuple(query.get("blacklist", ())))
+                     blacklist=tuple(query.get("blacklist", ())),
+                     categories=tuple(query.get("categories", ())))
     return query
 
 
@@ -380,12 +420,48 @@ def _winners_to_result(idx, scores, black, num: int,
         for item, (_, s) in zip(items, keep)))
 
 
-def _serve_topk(server, user_map: StringIndexBiMap,
-                item_map: StringIndexBiMap, query: Query) -> PredictedResult:
+def _category_blacklist(model, categories: Tuple[str, ...]) -> set:
+    """Item indices OUTSIDE the requested categories (filter-by-category
+    ALSAlgorithm.scala:85-101: recommendations restricted to the query
+    categories; items without categories are out). The inverted
+    category index and the per-categories complement are cached on the
+    model — the serving hot path must not pay an O(n_items) Python loop
+    per query."""
+    cache = getattr(model, "_cat_black_cache", None)
+    if cache is None:
+        cache = {}
+        model._cat_black_cache = cache
+    black = cache.get(categories)
+    if black is None:
+        index = getattr(model, "_cat_index", None)
+        if index is None:
+            index = {}
+            for ix, cats in model.item_categories.items():
+                for c in cats:
+                    index.setdefault(c, set()).add(ix)
+            model._cat_index = index
+        eligible: set = set()
+        for c in categories:
+            eligible |= index.get(c, set())
+        black = set(range(len(model.item_map))) - eligible
+        cache[categories] = black
+    return black
+
+
+def _serve_topk(server, model, query: Query) -> PredictedResult:
     """Shared device-serving logic for both ALS flavors: ask the compiled
     program for num + |blacklist| winners (seen items already masked on
-    device), drop blacklisted/non-positive ones host-side, clip to num."""
+    device), drop blacklisted/non-positive ones host-side, clip to num.
+    A category restriction joins the blacklist (with a full ranking, so
+    enough in-category candidates survive the cut)."""
+    user_map, item_map = model.user_map, model.item_map
     black = {item_map[i] for i in query.blacklist if i in item_map}
+    if query.categories:
+        if getattr(model, "item_categories", None) is None:
+            raise ValueError(
+                "query has categories but the model was trained without "
+                "read_item_categories=True on the datasource")
+        black = black | _category_blacklist(model, query.categories)
     k = query.num + len(black)
     if query.items:
         idxs = [item_map[i] for i in query.items if i in item_map]
@@ -413,8 +489,7 @@ class _DeviceServingAlgo:
 
     def predict(self, model, query: Query) -> PredictedResult:
         query = _coerce_query(query)
-        return _serve_topk(model.device_server(), model.user_map,
-                           model.item_map, query)
+        return _serve_topk(model.device_server(), model, query)
 
     def _batched_predict(self, model, indexed_queries
                          ) -> List[Tuple[int, Any]]:
@@ -429,8 +504,10 @@ class _DeviceServingAlgo:
         # (k needed) -> list of (qx, uidx, blacklist idx set, num)
         groups: Dict[int, List[Tuple[int, int, set, int]]] = {}
         for qx, q in queries:
+            # category queries need the full-ranking path in predict()
             uidx = (model.user_map.get(q.user)
-                    if q.user is not None and not q.items else None)
+                    if q.user is not None and not q.items
+                    and not q.categories else None)
             if uidx is None:
                 results[qx] = self.predict(model, q)
                 continue
@@ -459,7 +536,8 @@ class ALSAlgorithm(_DeviceServingAlgo, P2LAlgorithm):
         from predictionio_tpu.parallel.als_sharding import train_als_auto
 
         X, Y = train_als_auto(pd.user_side, pd.item_side, self.params)
-        return ALSModel(X, Y, pd.user_map, pd.item_map, pd.seen)
+        return ALSModel(X, Y, pd.user_map, pd.item_map, pd.seen,
+                        item_categories=pd.item_categories)
 
     def batch_predict(self, ctx: ComputeContext, model: "ALSModel",
                       indexed_queries) -> List[Tuple[int, Any]]:
@@ -481,6 +559,7 @@ class ShardedALSModel(_DeviceServedModel):
     user_map: StringIndexBiMap
     item_map: StringIndexBiMap
     seen: Dict[int, np.ndarray]
+    item_categories: Optional[Dict[int, Tuple[str, ...]]] = None
     _server: Any = dataclasses.field(default=None, repr=False, compare=False)
 
     def _make_server(self):
@@ -518,7 +597,8 @@ class ALSShardedAlgorithm(_DeviceServingAlgo, PAlgorithm):
         X, Y = train_als_device(pd.user_side, pd.item_side, self.params)
         return ShardedALSModel(
             X, Y, pd.user_side.n_rows, pd.user_side.n_cols,
-            pd.user_map, pd.item_map, pd.seen)
+            pd.user_map, pd.item_map, pd.seen,
+            item_categories=pd.item_categories)
 
     def batch_predict(self, ctx: ComputeContext, model: ShardedALSModel,
                       indexed_queries) -> List[Tuple[int, Any]]:
@@ -530,6 +610,36 @@ class ALSShardedAlgorithm(_DeviceServingAlgo, PAlgorithm):
 
 class RecommendationServing(LFirstServing):
     """First-serving (template Serving.scala returns the single result)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingParams(Params):
+    """custom-serving variant (its Serving.scala:10): path of a file
+    listing disabled product ids, one per line."""
+
+    filepath: str = "disabled.txt"
+
+
+class FileBlacklistServing(LServing):
+    """custom-serving variant: re-read the disabled-products file on
+    EVERY query (deliberate in the reference — ops can edit the file
+    under a live server) and drop those items from the first
+    algorithm's result (custom-serving/.../Serving.scala:13-27)."""
+
+    params_class = ServingParams
+
+    def serve(self, query: Query,
+              predictions: List[PredictedResult]) -> PredictedResult:
+        import os
+
+        filepath = getattr(self.params, "filepath", "disabled.txt")
+        disabled = set()
+        if os.path.exists(filepath):
+            with open(filepath, "r", encoding="utf-8") as f:
+                disabled = {ln.strip() for ln in f if ln.strip()}
+        head = predictions[0]
+        return PredictedResult(tuple(
+            s for s in head.item_scores if s.item not in disabled))
 
 
 class PrecisionAtK(OptionAverageMetric):
@@ -592,12 +702,15 @@ class RecommendationEvaluation(Evaluation, RecommendationParamsList):
 
 
 def engine_factory() -> Engine:
-    """EngineFactory analog (custom-query Engine.scala:13-19)."""
+    """EngineFactory analog (custom-query Engine.scala:13-19). The
+    custom-serving variant registers FileBlacklistServing under
+    "fileblacklist" (select via engine.json serving section)."""
     return Engine(
         EventDataSource,
         RatingsPreparator,
         {"als": ALSAlgorithm, "": ALSAlgorithm},
-        RecommendationServing,
+        {"": RecommendationServing,
+         "fileblacklist": FileBlacklistServing},
     )
 
 

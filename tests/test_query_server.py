@@ -185,7 +185,8 @@ class TestFeedbackLoop:
             assert ev.event == "predict"
             props = ev.properties
             assert props["query"] == {"user": "u1", "items": [],
-                                      "num": 10, "blacklist": []}
+                                      "num": 10, "blacklist": [],
+                                      "categories": []}
             assert props["prediction"]["itemScores"]
             assert props["engineInstanceId"]
         finally:
@@ -458,6 +459,7 @@ class TestHelpers:
     def test_to_jsonable(self):
         q = Query(user="u1", items=("a", "b"))
         assert to_jsonable(q) == {"user": "u1", "items": ["a", "b"],
-                                  "num": 10, "blacklist": []}
+                                  "num": 10, "blacklist": [],
+                                  "categories": []}
         assert to_jsonable(np.float32(1.5)) == 1.5
         assert to_jsonable({"a": np.arange(2)}) == {"a": [0, 1]}

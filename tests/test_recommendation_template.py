@@ -156,6 +156,85 @@ class TestTemplate:
         assert models[0].user_factors.shape[1] == 4
 
 
+class TestRecommendationVariants:
+    """filter-by-category and custom-serving variants
+    (examples/scala-parallel-recommendation/{filter-by-category,
+    custom-serving})."""
+
+    @pytest.fixture
+    def categorized_app(self, rated_app):
+        """Add $set item categories: a* items are 'alpha', b* 'beta'."""
+        le = storage.get_levents()
+        t0 = dt.datetime(2021, 1, 2, tzinfo=UTC)
+        cats = []
+        for g, cat in (("a", "alpha"), ("b", "beta")):
+            for i in range(10):
+                cats.append(Event(event="$set", entity_type="item",
+                                  entity_id=f"{g}{i}",
+                                  properties={"categories": [cat]},
+                                  event_time=t0))
+        le.insert_batch(cats, rated_app)
+        return rated_app
+
+    def cat_params(self):
+        return EngineParams(
+            data_source_params=("", DataSourceParams(
+                app_name="recapp", read_item_categories=True)),
+            algorithm_params_list=[
+                ("als", ALSParams(rank=8, num_iterations=8, lambda_=0.05,
+                                  seed=42))])
+
+    def test_category_filter(self, categorized_app):
+        engine = engine_factory()
+        params = self.cat_params()
+        [model] = engine.train(CTX, params)
+        algo = engine._algorithms(params)[0]
+        # u1 loves a* items; restricted to beta only b* may come back
+        r = algo.predict(model, Query(user="u1", num=5,
+                                      categories=("beta",)))
+        assert r.item_scores
+        assert all(s.item.startswith("b") for s in r.item_scores)
+        # unrestricted still prefers the a group
+        r2 = algo.predict(model, Query(user="u1", num=5))
+        assert r2.item_scores[0].item.startswith("a")
+        # unknown category -> nothing qualifies
+        assert algo.predict(model, Query(user="u1", num=5,
+                                         categories=("nope",))) \
+            .item_scores == ()
+
+    def test_category_query_without_flag_refused(self, rated_app):
+        engine = engine_factory()
+        params = engine_params()  # read_item_categories NOT set
+        [model] = engine.train(CTX, params)
+        algo = engine._algorithms(params)[0]
+        with pytest.raises(ValueError, match="read_item_categories"):
+            algo.predict(model, Query(user="u1", categories=("alpha",)))
+
+    def test_file_blacklist_serving(self, rated_app, tmp_path):
+        from predictionio_tpu.templates.recommendation.engine import (
+            FileBlacklistServing, ServingParams,
+        )
+
+        engine = engine_factory()
+        params = engine_params()
+        [model] = engine.train(CTX, params)
+        algo = engine._algorithms(params)[0]
+        base = algo.predict(model, Query(user="u1", num=5))
+        top = base.item_scores[0].item
+
+        disabled = tmp_path / "disabled.txt"
+        disabled.write_text(f"{top}\n")
+        serving = FileBlacklistServing(ServingParams(
+            filepath=str(disabled)))
+        served = serving.serve(Query(user="u1", num=5), [base])
+        assert top not in {s.item for s in served.item_scores}
+        assert len(served.item_scores) == len(base.item_scores) - 1
+        # the file is re-read per query: editing it changes the NEXT serve
+        disabled.write_text("")
+        served2 = serving.serve(Query(user="u1", num=5), [base])
+        assert len(served2.item_scores) == len(base.item_scores)
+
+
 class TestEvaluation:
     """PrecisionAtK + the tuning grid + the `pio eval` dataflow
     (MetricEvaluator.scala:190-246 over ALSAlgorithm.scala:64-103)."""
