@@ -63,6 +63,14 @@ class DataSourceParams(Params):
     # queries can restrict recommendations to categories
     # (filter-by-category/.../DataSource.scala:60-79)
     read_item_categories: bool = False
+    # sliding-window evaluation (the mlc movielens-evaluation example's
+    # EventsSlidingEvalParams: firstTrainingUntilTime / evalDuration /
+    # evalCount): eval set k trains on events before
+    # first_until + k*duration and tests on the following window.
+    # eval_count = 0 keeps the default leave-last-out protocol.
+    eval_first_until: Optional[str] = None   # ISO-8601
+    eval_duration_days: float = 7.0
+    eval_count: int = 0
 
 
 @dataclasses.dataclass
@@ -125,6 +133,20 @@ class TrainingData:
         assert len(self), (
             "ratings in TrainingData cannot be empty. Please check if "
             "DataSource generates TrainingData correctly.")
+
+
+def _training_data_prechecked(users: np.ndarray, items: np.ndarray,
+                              values: np.ndarray) -> "TrainingData":
+    """TrainingData from columns ALREADY validated for None ids —
+    sliding eval slices one validated batch per window and must not
+    re-pay the O(n) scan eval_count times."""
+    td = TrainingData.__new__(TrainingData)
+    td.users = users
+    td.items = items
+    td.values = values
+    td.item_categories = None
+    td._ratings = None
+    return td
 
 
 class IndexedTrainingData:
@@ -211,9 +233,14 @@ class EventDataSource(PDataSource):
         }
 
     def read_eval(self, ctx: ComputeContext):
-        """k-fold style eval: hold out every k-th rating per user as the
-        actual; query asks for top-N (readEval analog in the template's
-        evaluation variant)."""
+        """Default: leave-last-out per user (readEval analog in the
+        template's evaluation variant). With ``eval_count`` > 0:
+        time-sliding windows (train on everything before the cut, test
+        on the next window — EventsSlidingEvalParams semantics from the
+        reference's movielens-evaluation example)."""
+        p: DataSourceParams = self.params
+        if p.eval_count > 0:
+            return self._sliding_eval(p)
         td = self.read_training(ctx)
         if isinstance(td, IndexedTrainingData):
             # eval works on typed ratings; decode the streamed triples
@@ -233,6 +260,61 @@ class EventDataSource(PDataSource):
             train.extend(rs[:-1])
             qa.append((Query(user=user, num=10), ActualResult([held.item])))
         return [(TrainingData(train), EmptyEvalInfo(), qa)]
+
+    def _sliding_eval(self, p: DataSourceParams):
+        """Sliding time windows: for k in range(eval_count), train on
+        events before ``first_until + k*duration`` and hold out each
+        user's items in the following window as actuals."""
+        import datetime as _dt
+
+        from predictionio_tpu.data.event import _parse_time
+
+        if not p.eval_first_until:
+            raise ValueError(
+                "eval_count > 0 requires eval_first_until (ISO-8601)")
+        if p.streaming_block_size:
+            raise ValueError(
+                "sliding-window eval materializes the scanned window and "
+                "is incompatible with streaming_block_size; drop one of "
+                "the two (the scan is bounded to the eval horizon)")
+        first_until = _parse_time(p.eval_first_until)
+        t0 = first_until.timestamp()
+        dur = float(p.eval_duration_days) * 86400.0
+        horizon = first_until + _dt.timedelta(
+            seconds=dur * int(p.eval_count))
+        # the scan never needs events past the last test window
+        batch = PEventStore.find_columnar(
+            app_name=p.app_name, channel_name=p.channel_name,
+            entity_type="user", event_names=list(p.event_names),
+            target_entity_type="item", value_property="rating",
+            default_value=1.0, until_time=horizon)
+        # validate the id columns ONCE; per-window slices reuse them
+        probe = TrainingData(users=batch.entity_ids,
+                             items=batch.target_ids, values=batch.values)
+        del probe
+        times = batch.event_times
+        sets = []
+        for k in range(int(p.eval_count)):
+            cut = t0 + k * dur
+            train_mask = times < cut
+            if not train_mask.any():
+                raise ValueError(
+                    f"sliding-eval window {k} has no training events "
+                    f"before {p.eval_first_until} + {k} windows — move "
+                    "eval_first_until later or reduce eval_count")
+            test_mask = (times >= cut) & (times < cut + dur)
+            td = _training_data_prechecked(
+                batch.entity_ids[train_mask],
+                batch.target_ids[train_mask],
+                batch.values[train_mask])
+            held: Dict[str, List[str]] = {}
+            for u, i in zip(batch.entity_ids[test_mask],
+                            batch.target_ids[test_mask]):
+                held.setdefault(str(u), []).append(str(i))
+            qa = [(Query(user=u, num=10), ActualResult(items))
+                  for u, items in held.items()]
+            sets.append((td, EmptyEvalInfo(), qa))
+        return sets
 
 
 @dataclasses.dataclass(frozen=True)
