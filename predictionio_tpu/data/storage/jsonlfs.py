@@ -32,6 +32,7 @@ from __future__ import annotations
 import contextlib
 import fcntl
 import glob
+import logging
 import os
 import shutil
 import threading
@@ -50,6 +51,21 @@ from predictionio_tpu.data.storage.memory import match_event
 
 DEFAULT_PART_MAX_EVENTS = 500_000
 
+_log = logging.getLogger(__name__)
+
+
+def _parse_event_line(raw: str, source: str) -> Optional[Event]:
+    """A line that fails to parse is never a committed event — it is a
+    torn fragment from a killed append (terminated by ``_repair_tail``)
+    or external corruption. Skip it with a warning instead of letting one
+    bad line poison every later read of the partition."""
+    try:
+        return Event.from_json(raw)
+    except Exception:
+        _log.warning("jsonlfs: skipping unparsable line in %s "
+                     "(torn append fragment?)", source)
+        return None
+
 
 class JsonlFsLEvents(base.LEvents):
     """LEvents over partitioned JSONL files (one dir per app/channel)."""
@@ -60,7 +76,8 @@ class JsonlFsLEvents(base.LEvents):
             os.getcwd(), ".pio_store", "events_jsonl")
         self._part_max = int(cfg.get("part_max_events",
                                      DEFAULT_PART_MAX_EVENTS))
-        # dir -> [last_part_index, events_in_last_part]
+        # dir -> [last_part_index, events_in_last_part, bytes_in_last_part]
+        # (byte size validates the cache against other writers' appends)
         self._writers: dict = {}
         self._lock = threading.RLock()          # guards dicts only
         self._dir_tlocks: dict = {}             # dir -> threading.RLock
@@ -94,24 +111,58 @@ class JsonlFsLEvents(base.LEvents):
                 finally:
                     fcntl.flock(lf.fileno(), fcntl.LOCK_UN)
 
-    def _writer_state(self, d: str) -> list:
-        """Caller must hold the DIRECTORY lock; the global ``_lock`` is
-        only taken around dict access, so the (possibly large) partition
+    @staticmethod
+    def _repair_tail(path: str) -> None:
+        """Terminate a torn final line (killed mid-append): without this
+        the next append would glue new JSON onto the fragment. Terminated,
+        the fragment is its own (unparsable) line, which readers skip."""
+        try:
+            with open(path, "rb+") as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() == 0:
+                    return
+                f.seek(-1, os.SEEK_END)
+                if f.read(1) != b"\n":
+                    f.write(b"\n")
+        except FileNotFoundError:
+            pass
+
+    def _derive_state(self, d: str) -> list:
+        """Last partition's [index, line count, byte size] from disk,
+        repairing a torn tail first. Caller holds the directory lock; the
+        global ``_lock`` is never taken here, so the (possibly large)
         recount never stalls writes to other apps."""
+        parts = self._parts(d)
+        if not parts:
+            return [0, 0, 0]
+        idx = int(os.path.basename(parts[-1])[5:-6])
+        self._repair_tail(parts[-1])
+        with open(parts[-1], "rb") as f:
+            cnt = sum(chunk.count(b"\n") for chunk in
+                      iter(lambda: f.read(1 << 20), b""))
+        return [idx, cnt, os.path.getsize(parts[-1])]
+
+    def _writer_state(self, d: str) -> list:
+        """Caller must hold the DIRECTORY lock. The cached
+        [part_idx, count, size] is validated against the partition's
+        on-disk byte size on every call, so a second legal writer
+        (eventserver + CLI import share the flock) can never leave this
+        instance appending with a stale count and overfilling a part."""
         with self._lock:
             st = self._writers.get(d)
         if st is not None:
-            return st
-        parts = self._parts(d)
-        if parts:
-            idx = int(os.path.basename(parts[-1])[5:-6])
-            with open(parts[-1], "rb") as f:
-                cnt = sum(chunk.count(b"\n") for chunk in
-                          iter(lambda: f.read(1 << 20), b""))
-        else:
-            idx, cnt = 0, 0
+            path = os.path.join(d, f"part-{st[0]:05d}.jsonl")
+            try:
+                if os.path.getsize(path) == st[2]:
+                    return st
+            except OSError:
+                pass  # partition vanished or never written: re-derive
+        fresh = self._derive_state(d)
         with self._lock:
-            return self._writers.setdefault(d, [idx, cnt])
+            st = self._writers.setdefault(d, fresh)
+            if st is not fresh:
+                st[:] = fresh
+        return st
 
     # -- lifecycle --------------------------------------------------------
 
@@ -163,28 +214,39 @@ class JsonlFsLEvents(base.LEvents):
             st = self._writer_state(d)
             pos = 0
             while pos < len(lines):
-                if st[1] >= self._part_max:
-                    st[0] += 1
-                    st[1] = 0
+                while st[1] >= self._part_max:
+                    nxt = os.path.join(d, f"part-{st[0] + 1:05d}.jsonl")
+                    # another writer may have rolled past this partition
+                    # already — jump to the true last part in that case
+                    st[:] = self._derive_state(d) if os.path.exists(nxt) \
+                        else [st[0] + 1, 0, 0]
                 room = self._part_max - st[1]
                 chunk = lines[pos:pos + room]
                 path = os.path.join(d, f"part-{st[0]:05d}.jsonl")
-                with open(path, "a", encoding="utf-8") as f:
-                    f.write("\n".join(chunk))
-                    f.write("\n")
+                payload = ("\n".join(chunk) + "\n").encode("utf-8")
+                with open(path, "ab") as f:
+                    f.write(payload)
                 st[1] += len(chunk)
+                st[2] += len(payload)
                 pos += len(chunk)
 
     # -- reads ------------------------------------------------------------
 
     def _iter_events(self, d: str) -> Iterable[Event]:
-        """All events of one app/channel, storage order, typed."""
+        """All events of one app/channel, storage order, typed. An
+        unterminated trailing line (a racing live append's partial flush)
+        is not a committed event and is skipped without a lock; streaming
+        (never the whole partition in memory)."""
         for part in self._parts(d):
             with open(part, "r", encoding="utf-8") as f:
                 for line in f:
+                    if not line.endswith("\n"):
+                        break  # in-flight append or torn crash fragment
                     line = line.strip()
                     if line:
-                        yield Event.from_json(line)
+                        e = _parse_event_line(line, part)
+                        if e is not None:
+                            yield e
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
@@ -203,9 +265,13 @@ class JsonlFsLEvents(base.LEvents):
             for part in self._parts(d):
                 with open(part, "r", encoding="utf-8") as f:
                     lines = f.readlines()
-                kept = [ln for ln in lines
-                        if not (needle in ln
-                                and Event.from_json(ln).event_id == event_id)]
+                def _is_target(ln: str) -> bool:
+                    if needle not in ln:
+                        return False
+                    e = _parse_event_line(ln, part)
+                    return e is not None and e.event_id == event_id
+
+                kept = [ln for ln in lines if not _is_target(ln)]
                 if len(kept) != len(lines):
                     with open(part, "w", encoding="utf-8") as f:
                         f.writelines(kept)
@@ -238,8 +304,11 @@ class JsonlFsLEvents(base.LEvents):
                         raw = data[parsed.line_start[i]:
                                    parsed.line_end[i]].decode(
                             "utf-8", errors="replace").strip()
-                        times[i] = Event.from_json(raw) \
-                            .event_time.timestamp()
+                        e = _parse_event_line(raw, part)
+                        # unparsable torn fragments get dropped by the
+                        # rewrite along with the pre-cutoff events
+                        times[i] = e.event_time.timestamp() \
+                            if e is not None else float("-inf")
                     keep = times >= cutoff
                     kept = [data[parsed.line_start[i]:parsed.line_end[i]]
                             for i in np.nonzero(keep)[0]]
@@ -264,8 +333,11 @@ class JsonlFsLEvents(base.LEvents):
         for line in data.split(b"\n"):
             if not line.strip():
                 continue
-            e = Event.from_json(line.decode("utf-8", errors="replace"))
-            if e.event_time.timestamp() >= cutoff:
+            e = _parse_event_line(line.decode("utf-8", errors="replace"),
+                                  "delete_until")
+            if e is None:
+                dropped += 1
+            elif e.event_time.timestamp() >= cutoff:
                 kept.append(line)
             else:
                 dropped += 1
@@ -308,6 +380,11 @@ class JsonlFsPEvents(base.LEventsBackedPEvents):
         for part in lev._parts(d):
             with open(part, "rb") as f:
                 data = f.read()
+            if data and not data.endswith(b"\n"):
+                # an unterminated tail is a racing live append's partial
+                # flush (or a torn crash fragment) — not a committed
+                # event; scan only the complete lines
+                data = data[:data.rfind(b"\n") + 1]
             # a part may yield TWO blocks: the (encoded) bulk of the
             # file plus a small object-form block of fallback rows — one
             # exotic line must not de-optimize the whole partition
@@ -368,9 +445,9 @@ class JsonlFsPEvents(base.LEventsBackedPEvents):
             # needed just for rows whose time the C++ parser punted on
             columns={codec.COL_EVENT_TIME_RAW})
         if parsed is None:  # no native lib: python oracle on the whole part
-            events = [Event.from_json(ln)
-                      for ln in data.decode("utf-8").splitlines()
-                      if ln.strip()]
+            events = [e for ln in data.decode("utf-8").splitlines()
+                      if ln.strip()
+                      and (e := _parse_event_line(ln, source)) is not None]
             kept = [e for e in events
                     if match_event(e, start_time, until_time, entity_type,
                                    None, event_names, target_entity_type,
@@ -456,7 +533,9 @@ class JsonlFsPEvents(base.LEventsBackedPEvents):
             for i in fb_rows:
                 raw = data[parsed.line_start[i]:parsed.line_end[i]] \
                     .decode("utf-8", errors="replace").strip()
-                e = Event.from_json(raw)
+                e = _parse_event_line(raw, source)
+                if e is None:
+                    continue
                 if match_event(e, start_time, until_time, entity_type,
                                None, event_names, target_entity_type,
                                UNSET):

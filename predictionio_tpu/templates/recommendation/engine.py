@@ -20,6 +20,7 @@ cleanly into the Models repository (persistence mode 1).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -502,31 +503,46 @@ def _winners_to_result(idx, scores, black, num: int,
         for item, (_, s) in zip(items, keep)))
 
 
+_CAT_BLACKLIST_CACHE_MAX = 64
+_cat_cache_lock = threading.Lock()
+
+
 def _category_blacklist(model, categories: Tuple[str, ...]) -> set:
     """Item indices OUTSIDE the requested categories (filter-by-category
     ALSAlgorithm.scala:85-101: recommendations restricted to the query
     categories; items without categories are out). The inverted
     category index and the per-categories complement are cached on the
     model — the serving hot path must not pay an O(n_items) Python loop
-    per query."""
-    cache = getattr(model, "_cat_black_cache", None)
-    if cache is None:
-        cache = {}
-        model._cat_black_cache = cache
-    black = cache.get(categories)
-    if black is None:
-        index = getattr(model, "_cat_index", None)
-        if index is None:
-            index = {}
-            for ix, cats in model.item_categories.items():
-                for c in cats:
-                    index.setdefault(c, set()).add(ix)
-            model._cat_index = index
-        eligible: set = set()
-        for c in categories:
-            eligible |= index.get(c, set())
-        black = set(range(len(model.item_map))) - eligible
+    per query. The complement cache is a bounded LRU: each entry is
+    O(n_items), and a public endpoint can present unboundedly many
+    distinct category combinations. Mutations take a lock — the query
+    server serves on concurrent threads (ThreadingHTTPServer)."""
+    import collections
+
+    with _cat_cache_lock:
+        cache = getattr(model, "_cat_black_cache", None)
+        if cache is None:
+            cache = collections.OrderedDict()
+            model._cat_black_cache = cache
+        black = cache.get(categories)
+        if black is not None:
+            cache.move_to_end(categories)
+            return black
+    index = getattr(model, "_cat_index", None)
+    if index is None:
+        index = {}
+        for ix, cats in model.item_categories.items():
+            for c in cats:
+                index.setdefault(c, set()).add(ix)
+        model._cat_index = index
+    eligible: set = set()
+    for c in categories:
+        eligible |= index.get(c, set())
+    black = set(range(len(model.item_map))) - eligible
+    with _cat_cache_lock:
         cache[categories] = black
+        while len(cache) > _CAT_BLACKLIST_CACHE_MAX:
+            cache.popitem(last=False)
     return black
 
 

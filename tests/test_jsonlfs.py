@@ -71,6 +71,75 @@ class TestPartitioning:
         assert len(list(le2.find(app_id=APP))) == 7
 
 
+class TestTornAppendRecovery:
+    """A killed writer leaves an unterminated final line; neither the
+    next append nor any reader may be poisoned by it (ADVICE r4)."""
+
+    def _torn_store(self, tmp_path, n_good=4):
+        le = JsonlFsLEvents({"path": str(tmp_path / "ev"),
+                             "part_max_events": 100})
+        le.init(APP)
+        le.insert_batch(seed_events(n_good), APP)
+        part = le._parts(le._dir(APP, None))[-1]
+        with open(part, "a", encoding="utf-8") as f:
+            f.write('{"event":"rate","entityType":"user","entityId"')
+        return le, part
+
+    def test_next_append_does_not_glue(self, tmp_path):
+        le, part = self._torn_store(tmp_path)
+        # a FRESH writer (simulating restart after the crash) appends
+        le2 = JsonlFsLEvents({"path": str(tmp_path / "ev"),
+                              "part_max_events": 100})
+        le2.insert_batch(seed_events(3), APP)
+        got = list(le2.find(app_id=APP))
+        assert len(got) == 7  # 4 + 3; torn fragment is not an event
+        # the repaired fragment is its own line, not glued to new JSON
+        with open(part, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        assert sum(ln.endswith('"entityId"') for ln in lines) == 1
+
+    def test_same_instance_append_repairs(self, tmp_path):
+        le, part = self._torn_store(tmp_path)
+        # same instance: cached writer state is invalidated by the size
+        # check, the tail repaired, and the new batch lands cleanly
+        le.insert_batch(seed_events(2), APP)
+        assert len(list(le.find(app_id=APP))) == 6
+
+    def test_readers_tolerate_torn_tail(self, tmp_path):
+        le, part = self._torn_store(tmp_path)
+        # typed reads skip the unterminated fragment without raising
+        assert len(list(le.find(app_id=APP))) == 4
+        # columnar reads too (both codec and oracle paths trim the tail)
+        pe = JsonlFsPEvents({"path": str(tmp_path / "ev")})
+        batch = pe.find_columnar(APP, value_property="rating")
+        assert len(batch) == 4
+
+    def test_delete_until_drops_terminated_fragment(self, tmp_path):
+        le, part = self._torn_store(tmp_path)
+        le._repair_tail(part)
+        removed = le.delete_until(APP, t(2))
+        # 2 pre-cutoff events + the unparsable fragment
+        assert removed == 3
+        assert len(list(le.find(app_id=APP))) == 2
+
+    def test_second_writer_rolls_partitions_correctly(self, tmp_path):
+        """Two live writer instances on one dir (eventserver + CLI
+        import): neither overfills a partition from a stale cache."""
+        a = JsonlFsLEvents({"path": str(tmp_path / "ev"),
+                            "part_max_events": 3})
+        b = JsonlFsLEvents({"path": str(tmp_path / "ev"),
+                            "part_max_events": 3})
+        a.init(APP)
+        for i in range(4):
+            a.insert_batch(seed_events(2), APP)
+            b.insert_batch(seed_events(2), APP)
+        d = a._dir(APP, None)
+        for part in a._parts(d):
+            with open(part, encoding="utf-8") as f:
+                assert len(f.read().splitlines()) <= 3
+        assert len(list(a.find(app_id=APP))) == 16
+
+
 class TestColumnar:
     def test_matches_generic_oracle(self, store):
         got = store.find_columnar(
