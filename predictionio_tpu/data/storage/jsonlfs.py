@@ -238,7 +238,10 @@ class JsonlFsLEvents(base.LEvents):
         is not a committed event and is skipped without a lock; streaming
         (never the whole partition in memory)."""
         for part in self._parts(d):
-            with open(part, "r", encoding="utf-8") as f:
+            # errors="replace": a fragment torn mid-multibyte character
+            # must not poison the whole partition with UnicodeDecodeError
+            with open(part, "r", encoding="utf-8",
+                      errors="replace") as f:
                 for line in f:
                     if not line.endswith("\n"):
                         break  # in-flight append or torn crash fragment
@@ -263,8 +266,10 @@ class JsonlFsLEvents(base.LEvents):
         needle = f'"{event_id}"'
         with self._dir_lock(d):
             for part in self._parts(d):
-                with open(part, "r", encoding="utf-8") as f:
+                with open(part, "r", encoding="utf-8",
+                          errors="replace") as f:
                     lines = f.readlines()
+
                 def _is_target(ln: str) -> bool:
                     if needle not in ln:
                         return False
@@ -273,8 +278,12 @@ class JsonlFsLEvents(base.LEvents):
 
                 kept = [ln for ln in lines if not _is_target(ln)]
                 if len(kept) != len(lines):
-                    with open(part, "w", encoding="utf-8") as f:
+                    # atomic replace (as delete_until): a crash
+                    # mid-rewrite must never lose the surviving events
+                    tmp = part + ".tmp"
+                    with open(tmp, "w", encoding="utf-8") as f:
                         f.writelines(kept)
+                    os.replace(tmp, part)
                     with self._lock:
                         self._writers.pop(d, None)  # recount on append
                     return True

@@ -1,21 +1,33 @@
-"""Pallas TPU kernel: fused gather + normal-equation assembly for ALS.
+"""Pallas TPU kernels for ALS.
 
-The XLA path in ``ops/als.py`` computes ``Yg = take(Y, cols)`` ([B, L, R],
-materialized in HBM) followed by two einsums. This kernel fuses the
-gather with the per-row normal-equation assembly: cols indices live in
-SMEM, each grid step DMA-gathers its rows' factor vectors from HBM into a
-VMEM scratch (DMA engines take the arbitrary dynamic offsets the vector
-ISA cannot), and per-row MXU matmuls produce ``A_b`` ([R, R]) and ``b_b``
-([R]) without the [B, L, R] intermediate ever round-tripping HBM.
+Two kernels live here:
 
-STATUS — correctness-proven, not the default. Measured on a real v5e
-chip at MovieLens-100K scale (943x1682, rank 64): XLA's fused
-take+einsum half-step runs ~0.02 ms vs ~2.5 ms for this kernel — the
-serial row-by-row DMA dominates and XLA's gather fusion is already
-excellent, so ``ops/als.py`` keeps the XLA path. The kernel stays as the
-exercised foundation for DMA-gather work (pipelined/batched DMA would be
-the next step if a profile ever shows the XLA gather as the bottleneck),
-with interpret-mode tests asserting exact agreement with the XLA math.
+1. ``spd_solve`` — batched symmetric positive-definite solve (Cholesky
+   factorization + forward/backward triangular substitution fused in
+   one kernel, batch on the lane dimension, matrices resident in VMEM
+   across all R steps). XLA's batched ``cho_factor``/``cho_solve`` is
+   the measured bottleneck of the ALS epoch on TPU (~1.1 s for 138k
+   rank-64 systems at the 10M-event scale — its per-column expansion
+   round-trips HBM every step). STATUS — experimental, NOT the default:
+   an earlier batch-major variant compiled but ran slower than
+   cho_solve (1.6 s; lane padding waste + loop-carry copies), and this
+   lane-major variant's dynamic ref indexing wedged the Mosaic compile
+   pipeline on the available toolchain. The production TPU solver is
+   the pure-XLA batch-on-lanes blocked panel factorization
+   ``ops.als.spd_solve_lanes`` (same layout idea, plain dynamic_slice
+   ops, one MXU rank-`panel` trailing update per panel); this kernel is
+   opt-in via ``PIO_ALS_SOLVER=pallas`` and exercised in interpret mode
+   by tests.
+
+2. ``assemble_normal_equations`` — fused gather + normal-equation
+   assembly. STATUS: correctness-proven, not the default. Measured on a
+   real v5e chip at MovieLens-100K scale (943x1682, rank 64): XLA's
+   fused take+einsum half-step runs ~0.02 ms vs ~2.5 ms for this kernel
+   — the serial row-by-row DMA dominates and XLA's gather fusion is
+   already excellent, so ``ops/als.py`` keeps the XLA path for
+   assembly. The kernel stays as the exercised foundation for
+   DMA-gather work, with interpret-mode tests asserting exact agreement
+   with the XLA math.
 
 Run on CPU (tests) via interpret mode — semantics identical, speed not.
 """
@@ -141,6 +153,136 @@ def assemble_normal_equations(Y, cols, aw, bw, gram,
     fn = _build(B + pad, L, M, R + rpad, bool(interpret))
     A, b = fn(cols, aw, bw, Y, gram)
     return A[:B, :R, :R], b[:B, :R]
+
+
+# ---------------------------------------------------------------------------
+# Batched SPD solve (the production kernel)
+# ---------------------------------------------------------------------------
+
+# systems per grid step == the lane width: each per-step scalar (pivot,
+# reciprocal sqrt, substitution coefficient) is a [BB]-lane vector
+_SPD_BB = 128
+
+
+def _spd_solve_kernel(a_ref, b_ref, x_ref, awork, lt, ywork, bwork):
+    """Solve ``A x = b`` for one block of ``BB`` SPD systems.
+
+    Layout is the whole trick: the batch lives on the LANE dimension
+    (``a_ref [R, R, BB]``), so every step of the non-pivoted
+    right-looking Cholesky — pivot extraction, column scaling, rank-1
+    trailing update — is a full-width VPU op over BB systems at once,
+    and row/column extraction is leading-dim indexing (sublane), never
+    dynamic lane slicing. The matrices stay in VMEM scratch across all
+    R steps; HBM sees each system exactly once in and once out. (XLA's
+    batched Cholesky/triangular ops round-trip HBM per step — the
+    measured ALS bottleneck this kernel replaces.)
+
+    The trailing update uses the symmetry of A: column k == row k, so
+    the pivot column is ``awork[k]`` directly."""
+    import jax
+    import jax.numpy as jnp
+
+    R = a_ref.shape[0]
+    awork[:] = a_ref[:]
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0)   # [R, 1]
+
+    def fact_step(k, _):
+        c = awork[k]                                # [R, BB] column k
+        d = jnp.maximum(awork[k, k], 1e-30)         # [BB] pivot (ref load)
+        inv = 1.0 / jnp.sqrt(d)
+        ge = (iota_r >= k).astype(jnp.float32)
+        lcol = c * inv[None, :] * ge                # L[:, k], rows >= k
+        u = lcol * (iota_r > k).astype(jnp.float32)
+        awork[:] = awork[:] - u[None, :, :] * u[:, None, :]
+        lt[k] = lcol                                # Lt row k == L col k
+        return 0
+
+    jax.lax.fori_loop(0, R, fact_step, 0)
+
+    # forward substitution L y = b, column sweep: rows < k of lt[k] are
+    # zero, so the update never touches already-solved entries
+    bwork[:] = b_ref[:]
+
+    def fwd_step(k, _):
+        yk = bwork[k] / lt[k, k]
+        ywork[k] = yk
+        bwork[:] = bwork[:] - lt[k] * yk[None, :]
+        return 0
+
+    jax.lax.fori_loop(0, R, fwd_step, 0)
+
+    # backward substitution Lt x = y, row sweep from the bottom
+    x_ref[:] = jnp.zeros_like(b_ref[:])
+
+    def bwd_step(i, _):
+        k = R - 1 - i
+        ltk = lt[k]                                 # Lt row k over j >= k
+        s = jnp.sum(ltk * x_ref[:], axis=0)         # x[k] still 0
+        x_ref[k] = (ywork[k] - s) / lt[k, k]
+        return 0
+
+    jax.lax.fori_loop(0, R, bwd_step, 0)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_spd(B: int, R: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    assert B % _SPD_BB == 0
+    fn = pl.pallas_call(
+        _spd_solve_kernel,
+        grid=(B // _SPD_BB,),
+        in_specs=[
+            pl.BlockSpec((R, R, _SPD_BB), lambda i: (0, 0, i)),
+            pl.BlockSpec((R, _SPD_BB), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((R, _SPD_BB), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((R, B), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((R, R, _SPD_BB), jnp.float32),   # awork
+            pltpu.VMEM((R, R, _SPD_BB), jnp.float32),   # lt
+            pltpu.VMEM((R, _SPD_BB), jnp.float32),      # ywork
+            pltpu.VMEM((R, _SPD_BB), jnp.float32),      # bwork
+        ],
+        interpret=interpret,
+    )
+    return fn
+
+
+# above this rank the three [R, R, BB] VMEM buffers exceed scoped VMEM;
+# callers fall back to XLA's cho_solve (see ops.als._spd_solve)
+SPD_MAX_RANK = 96
+
+
+def spd_solve(A, b, interpret: Optional[bool] = None):
+    """Batched SPD solve ``x: A @ x = b`` with ``A [B, R, R]``,
+    ``b [B, R]`` — the Pallas replacement for
+    ``cho_solve(cho_factor(A), b)``. Same math (non-pivoted Cholesky,
+    fp32); agreement asserted against scipy in tests and in the bench's
+    finiteness checks. The batch is padded to the kernel's lane-block
+    size with identity systems internally; inputs are transposed to the
+    kernel's batch-on-lanes layout (XLA fuses the transpose into the
+    producing einsum)."""
+    import jax
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, R = b.shape
+    At = jnp.transpose(A.astype(jnp.float32), (1, 2, 0))   # [R, R, B]
+    bt = b.astype(jnp.float32).T                           # [R, B]
+    pad = (-B) % _SPD_BB
+    if pad:
+        eye = jnp.broadcast_to(jnp.eye(R, dtype=jnp.float32)[:, :, None],
+                               (R, R, pad))
+        At = jnp.concatenate([At, eye], axis=2)
+        bt = jnp.concatenate([bt, jnp.zeros((R, pad), jnp.float32)],
+                             axis=1)
+    x = _build_spd(B + pad, R, bool(interpret))(At, bt)
+    return x[:, :B].T
 
 
 def solve_side_pallas(Y, cols, weights, mask, lam: float, alpha: float,
