@@ -245,6 +245,54 @@ def run(n_users: int = None, n_items: int = None, nnz: int = None,
     }
 
 
+def run_truncation_check(n_users: int = 6040, n_items: int = 3706,
+                         nnz: int = 1_000_000, trunc_max_len: int = 512,
+                         seed: int = 9) -> dict:
+    """Quality cost of max_len truncation at the ML-1M shape (round-4
+    verdict weak #2: the pairs a cut drops are the heaviest users' —
+    nothing measured what that cost). Trains the SAME split two ways —
+    length-bucketed 100% coverage vs uniform tables truncated at
+    ``trunc_max_len`` — and reports both Precision@10."""
+    from predictionio_tpu.ops.als import (
+        ALSParams,
+        bucket_ratings_pair,
+        pad_ratings,
+        train_als,
+        train_als_bucketed,
+    )
+
+    rows, cols, vals, held = build_split(n_users, n_items, nnz, seed)
+    params = ALSParams(rank=RANK, num_iterations=ITERATIONS,
+                       lambda_=LAMBDA, alpha=ALPHA, seed=3,
+                       bucket_slot_budget=4_000_000)
+
+    ub, ib = bucket_ratings_pair(rows, cols, vals, n_users, n_items)
+    Xf, Yf = train_als_bucketed(ub, ib, params)
+    p_full = precision_at_k(np.asarray(Xf), np.asarray(Yf), rows, cols,
+                            held)
+
+    ut = pad_ratings(rows, cols, vals, n_users, n_items,
+                     max_len=trunc_max_len)
+    it = pad_ratings(cols, rows, vals, n_items, n_users,
+                     max_len=trunc_max_len)
+    Xt, Yt = train_als(ut, it, params)
+    p_trunc = precision_at_k(np.asarray(Xt), np.asarray(Yt), rows, cols,
+                             held)
+    covered = int(ut.mask.sum() + it.mask.sum()) // 2
+    return {
+        "check": "truncation_vs_full_coverage",
+        "events": int(len(rows)),
+        "full_coverage_precision_at_10": round(p_full, 4),
+        "truncated_precision_at_10": round(p_trunc, 4),
+        "truncated_max_len": trunc_max_len,
+        "truncated_coverage_of_pairs": round(covered / len(rows), 3),
+        "full_coverage_occupancy": round(ub.occupancy, 3),
+        "note": ("bucketed layout trains every pair (coverage 1.0); the "
+                 "truncated uniform layout is what the scale bench used "
+                 "through round 4"),
+    }
+
+
 if __name__ == "__main__":
     import json
 

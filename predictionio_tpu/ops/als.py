@@ -99,16 +99,27 @@ def dedup_sum_ratings(rows: np.ndarray, cols: np.ndarray,
                       values: np.ndarray, n_cols: int):
     """Sum duplicate (row, col) pairs — the template's
     ``reduceByKey(_ + _)`` aggregation (custom-query
-    ALSAlgorithm.scala:50). Returns unique (rows, cols, summed values)."""
+    ALSAlgorithm.scala:50). Returns unique (rows, cols, summed values),
+    sorted by (row, col) — downstream bucketing relies on the row
+    grouping to skip its own sort.
+
+    One integer radix argsort + contiguous ``add.reduceat`` — several
+    times faster at 10M rows than the previous
+    ``np.unique(return_inverse)`` + ``np.add.at`` (scattered atomics).
+    """
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
     values = np.asarray(values, dtype=np.float32)
+    if not len(rows):
+        return rows, cols, values
     key = rows * n_cols + cols
-    uniq, inv = np.unique(key, return_inverse=True)
-    summed = np.zeros(len(uniq), dtype=np.float32)
-    np.add.at(summed, inv, values)
+    order = np.argsort(key, kind="stable")
+    k = key[order]
+    starts = np.flatnonzero(np.r_[True, k[1:] != k[:-1]])
+    sums = np.add.reduceat(values[order], starts).astype(np.float32)
+    uniq = k[starts]
     return (uniq // n_cols).astype(np.int64), \
-        (uniq % n_cols).astype(np.int64), summed
+        (uniq % n_cols).astype(np.int64), sums
 
 
 def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
@@ -125,19 +136,27 @@ def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
     rows, cols, values = dedup_sum_ratings(rows, cols, values, n_cols)
 
     counts = np.bincount(rows, minlength=n_rows)
-    L = int(counts.max()) if len(counts) and counts.max() > 0 else 1
+    true_top = int(counts.max()) if len(counts) and counts.max() > 0 else 1
+    L = true_top
     if max_len is not None and L > max_len:
         L = int(max_len)
     L = max(1, -(-L // pad_multiple) * pad_multiple)
 
-    order = np.lexsort((-np.abs(values), rows))  # by row, strongest first
-    rows, cols, values = rows[order], cols[order], values[order]
+    if true_top > L:
+        # truncation active: order each row strongest-magnitude first so
+        # the cut keeps the heaviest ratings; otherwise the (row-grouped)
+        # dedup order is used as-is — same intra-row order as the
+        # bucketed path, so both paths accumulate identically
+        order = np.lexsort((-np.abs(values), rows))
+        rows, cols, values = rows[order], cols[order], values[order]
     # position of each rating within its row
     row_starts = np.zeros(n_rows + 1, dtype=np.int64)
-    np.cumsum(np.bincount(rows, minlength=n_rows), out=row_starts[1:])
+    np.cumsum(counts, out=row_starts[1:])
     pos = np.arange(len(rows)) - row_starts[rows]
-    keep = pos < L
-    rows, cols, values, pos = rows[keep], cols[keep], values[keep], pos[keep]
+    if true_top > L:
+        keep = pos < L
+        rows, cols, values, pos = \
+            rows[keep], cols[keep], values[keep], pos[keep]
 
     out_cols = np.zeros((n_rows, L), dtype=np.int32)
     out_w = np.zeros((n_rows, L), dtype=np.float32)
@@ -262,8 +281,42 @@ def bucket_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
     row; an explicit ladder is clipped/extended to cover it.
     """
     rows, cols, values = dedup_sum_ratings(rows, cols, values, n_cols)
+    return _bucket_grouped(rows, cols, values, n_rows, n_cols,
+                           bucket_lengths, max_len, pad_multiple,
+                           row_multiple)
+
+
+def bucket_ratings_pair(
+        rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
+        n_rows: int, n_cols: int,
+        bucket_lengths: Optional[Sequence[int]] = None,
+        max_len: Optional[int] = None, pad_multiple: int = 8,
+        row_multiple: int = 8) -> Tuple[BucketedRatings, BucketedRatings]:
+    """Both solve sides from one pass: dedup-sum once, bucket the row
+    side from the (already row-grouped) result, and the column side
+    after a single radix re-sort — half the host work of calling
+    :func:`bucket_ratings` twice. Returns ``(row_side, col_side)``."""
+    rows, cols, values = dedup_sum_ratings(rows, cols, values, n_cols)
+    row_side = _bucket_grouped(rows, cols, values, n_rows, n_cols,
+                               bucket_lengths, max_len, pad_multiple,
+                               row_multiple)
+    o = np.argsort(cols, kind="stable")
+    col_side = _bucket_grouped(cols[o], rows[o], values[o], n_cols,
+                               n_rows, bucket_lengths, max_len,
+                               pad_multiple, row_multiple)
+    return row_side, col_side
+
+
+def _bucket_grouped(rows, cols, values, n_rows: int, n_cols: int,
+                    bucket_lengths, max_len, pad_multiple: int,
+                    row_multiple: int) -> BucketedRatings:
+    """Bucketing core over DEDUPED triples sorted by row (the
+    dedup_sum_ratings contract). Without truncation the incoming order
+    is used as-is; only a live ``max_len`` cut pays a lexsort to keep
+    each row's strongest-magnitude ratings."""
     counts = np.bincount(rows, minlength=n_rows)
-    L_top = int(counts.max()) if counts.size and counts.max() > 0 else 1
+    true_top = int(counts.max()) if counts.size and counts.max() > 0 else 1
+    L_top = true_top
     if max_len is not None:
         L_top = min(L_top, int(max_len))
     L_top = max(1, -(-L_top // pad_multiple) * pad_multiple)
@@ -285,15 +338,18 @@ def bucket_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
                for x in lengths]
     lengths = sorted(set(lengths))
 
-    # entry position within its row, strongest-magnitude first (so a
-    # max_len cut keeps the heaviest ratings, as pad_ratings does)
-    order = np.lexsort((-np.abs(values), rows))
-    rows, cols, values = rows[order], cols[order], values[order]
+    if true_top > L_top:
+        # truncation active: order each row strongest-magnitude first
+        # so the cut keeps the heaviest ratings (as pad_ratings does)
+        order = np.lexsort((-np.abs(values), rows))
+        rows, cols, values = rows[order], cols[order], values[order]
     row_starts = np.zeros(n_rows + 1, dtype=np.int64)
-    np.cumsum(np.bincount(rows, minlength=n_rows), out=row_starts[1:])
+    np.cumsum(counts, out=row_starts[1:])
     pos = np.arange(len(rows)) - row_starts[rows]
-    keep = pos < L_top
-    rows, cols, values, pos = rows[keep], cols[keep], values[keep], pos[keep]
+    if true_top > L_top:
+        keep = pos < L_top
+        rows, cols, values, pos = \
+            rows[keep], cols[keep], values[keep], pos[keep]
 
     eff = np.minimum(counts, L_top)
     b_of_row = np.searchsorted(lengths, eff, side="left")

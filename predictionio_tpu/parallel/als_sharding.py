@@ -24,7 +24,10 @@ import numpy as np
 
 from predictionio_tpu.ops.als import (
     ALSParams,
+    BucketedRatings,
     PaddedRatings,
+    RatingsBucket,
+    _als_iterations_bucketed_impl,
     _als_iterations_impl,
     init_factors,
 )
@@ -62,6 +65,13 @@ def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
     rating tables over 'data', place factors per ``factor_spec``, run the
     full iteration scan, slice padding back off."""
     import jax
+
+    if not isinstance(user_side, PaddedRatings):
+        raise TypeError(
+            "this ALS flavor trains uniform PaddedRatings tables; for "
+            "length-bucketed sides use train_als_bucketed_sharded (or "
+            "the default ALSAlgorithm via train_als_auto), or set "
+            "bucketed=False on the preparator")
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -200,10 +210,101 @@ def train_als_device(user_side: PaddedRatings, item_side: PaddedRatings,
                           dtype=dtype, gather=False)
 
 
-def train_als_auto(user_side: PaddedRatings, item_side: PaddedRatings,
-                   params: ALSParams, dtype=None
+def _pad_bucket_rows(b: RatingsBucket, multiple: int,
+                     sentinel: int) -> RatingsBucket:
+    """Pad a bucket's row count to ``multiple`` with sentinel-id empty
+    rows (dropped by the device scatter) so the table shards evenly."""
+    B = int(np.asarray(b.cols).shape[0])
+    pad = (-B) % multiple
+    if pad == 0:
+        return b
+
+    def z(a):
+        a = np.asarray(a)
+        return np.concatenate(
+            [a, np.zeros((pad, a.shape[1]), dtype=a.dtype)])
+    rid = np.concatenate([np.asarray(b.row_ids),
+                          np.full(pad, sentinel, dtype=np.int32)])
+    return RatingsBucket(rid, z(b.cols), z(b.weights), z(b.mask))
+
+
+def train_als_bucketed_sharded(user_side: BucketedRatings,
+                               item_side: BucketedRatings,
+                               params: ALSParams, mesh, dtype=None
+                               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Length-bucketed training over a device mesh.
+
+    Every bucket's table is row-sharded over the mesh's ``data`` axis
+    (rows padded to a lane-friendly multiple of the axis size with
+    sentinel ids); the factor matrices stay replicated, so each
+    device's per-bucket solves scatter into its replica and XLA merges
+    the disjoint scatters with one psum per half-step — the collective
+    analog of MLlib's factor shuffle, at bucketed occupancy instead of
+    longest-row padding."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ndev = int(mesh.shape.get("data", 1))
+    rows_sharded = NamedSharding(mesh, P("data", None))
+    ids_sharded = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P(None, None))
+    put = jax.device_put
+    multi_host = len({d.process_index for d in mesh.devices.flat}) > 1
+
+    def place_arr(a, sharding, spec):
+        """Single-host: plain device_put; multi-host: this host
+        contributes its contiguous row block (host-sharded ingest,
+        parallel/distributed.py)."""
+        if multi_host:
+            from predictionio_tpu.parallel import distributed
+
+            start, stop = distributed.process_row_block(a.shape[0])
+            return distributed.make_global_array(mesh, spec,
+                                                 np.asarray(a)[start:stop])
+        return put(jnp.asarray(a), sharding)
+
+    def place(side: BucketedRatings):
+        out = []
+        for b in side.buckets:
+            b = _pad_bucket_rows(b, 8 * ndev, side.n_rows)
+            out.append((place_arr(b.row_ids, ids_sharded, P("data")),
+                        place_arr(b.cols, rows_sharded, P("data", None)),
+                        place_arr(b.weights, rows_sharded,
+                                  P("data", None)),
+                        place_arr(b.mask, rows_sharded, P("data", None))))
+        return tuple(out)
+
+    X, Y = init_factors(user_side.n_rows, item_side.n_rows, params.rank,
+                        params.seed, dtype)
+    if multi_host:
+        from predictionio_tpu.parallel import distributed
+
+        X = distributed.make_global_array(mesh, P(None, None),
+                                          np.asarray(X))
+        Y = distributed.make_global_array(mesh, P(None, None),
+                                          np.asarray(Y))
+    else:
+        X, Y = put(X, repl), put(Y, repl)
+    fn = jax.jit(
+        _als_iterations_bucketed_impl,
+        static_argnames=("lam", "alpha", "implicit", "num_iterations",
+                         "slot_budget"),
+        out_shardings=(repl, repl))
+    X, Y = fn(X, Y, place(user_side), place(item_side),
+              lam=float(params.lambda_), alpha=float(params.alpha),
+              implicit=bool(params.implicit_prefs),
+              num_iterations=int(params.num_iterations),
+              slot_budget=None if not params.bucket_slot_budget
+              else int(params.bucket_slot_budget))
+    return np.asarray(X), np.asarray(Y)
+
+
+def train_als_auto(user_side, item_side, params: ALSParams, dtype=None
                    ) -> Tuple[np.ndarray, np.ndarray]:
-    """Topology-aware trainer — what the templates call.
+    """Topology-aware trainer — what the templates call. Accepts either
+    uniform :class:`PaddedRatings` or length-bucketed
+    :class:`BucketedRatings` sides (the Preparator's choice).
 
     Multi-host runtime (``pio train --num-hosts K``): a global host-aware
     mesh so all hosts train ONE collective program over DCN+ICI.
@@ -213,19 +314,30 @@ def train_als_auto(user_side: PaddedRatings, item_side: PaddedRatings,
     """
     import jax
 
-    from predictionio_tpu.ops.als import train_als
+    from predictionio_tpu.ops.als import train_als, train_als_bucketed
 
+    bucketed = isinstance(user_side, BucketedRatings)
     if jax.process_count() > 1:
         from predictionio_tpu.parallel import distributed
 
         mesh = distributed.host_aware_mesh()
+        if bucketed:
+            return train_als_bucketed_sharded(user_side, item_side,
+                                              params, mesh, dtype=dtype)
         return train_als_sharded(user_side, item_side, params, mesh,
                                  dtype=dtype)
     from predictionio_tpu.parallel.mesh import data_parallel_mesh
 
     if len(jax.devices()) > 1:
+        if bucketed:
+            return train_als_bucketed_sharded(
+                user_side, item_side, params, data_parallel_mesh(),
+                dtype=dtype)
         return train_als_sharded(user_side, item_side, params,
                                  data_parallel_mesh(), dtype=dtype)
+    if bucketed:
+        return train_als_bucketed(user_side, item_side, params,
+                                  dtype=dtype)
     return train_als(user_side, item_side, params, dtype=dtype)
 
 

@@ -10,6 +10,7 @@ from predictionio_tpu.templates.recommendation.engine import (
     ItemScore,
     PrecisionAtK,
     PredictedResult,
+    PreparatorParams,
     Query,
     RatingsPreparator,
     RecommendationEvaluation,
@@ -30,6 +31,7 @@ __all__ = [
     "ItemScore",
     "PrecisionAtK",
     "PredictedResult",
+    "PreparatorParams",
     "Query",
     "RatingsPreparator",
     "RecommendationEvaluation",

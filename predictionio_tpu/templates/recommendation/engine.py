@@ -190,18 +190,21 @@ class EventDataSource(PDataSource):
         if p.streaming_block_size:
             from predictionio_tpu.data.columnar import (
                 StreamingRatingsBuilder,
+                iter_blocks_threaded,
             )
 
             builder = StreamingRatingsBuilder()
-            for block in PEventStore.find_columnar_blocks(
-                    app_name=p.app_name,
-                    channel_name=p.channel_name,
-                    entity_type="user",
-                    event_names=list(p.event_names),
-                    target_entity_type="item",
-                    value_property="rating",
-                    default_value=1.0,
-                    block_size=int(p.streaming_block_size)):
+            # decode thread + indexing consumer overlap (bounded queue)
+            for block in iter_blocks_threaded(
+                    PEventStore.find_columnar_blocks(
+                        app_name=p.app_name,
+                        channel_name=p.channel_name,
+                        entity_type="user",
+                        event_names=list(p.event_names),
+                        target_entity_type="item",
+                        value_property="rating",
+                        default_value=1.0,
+                        block_size=int(p.streaming_block_size))):
                 builder.add_block(block)
             td = IndexedTrainingData(*builder.finalize())
             td.item_categories = self._read_item_categories(p)
@@ -374,11 +377,19 @@ class PreparedData:
 
 @dataclasses.dataclass(frozen=True)
 class PreparatorParams(Params):
-    """``max_len`` bounds the padded row length (keeping the
-    largest-magnitude ratings per row) — required at 10M+ scale where
-    the power-law tail would otherwise size the whole [N, L] table."""
+    """``bucketed=True`` lays the ratings out as length buckets
+    (``ops.als.bucket_ratings_pair``): each row pads only to its own
+    length class, so the solves stop multiplying longest-row padding
+    AND nothing is truncated — 100% pair coverage at any scale (the
+    full-RDD semantics of ``ALS.trainImplicit``). The recommended
+    layout at 10M+ events.
+
+    ``max_len`` bounds the padded row length (keeping the
+    largest-magnitude ratings per row); with ``bucketed=False`` it is
+    what kept the uniform [N, L] table affordable at scale."""
 
     max_len: Optional[int] = None
+    bucketed: bool = False
 
 
 class RatingsPreparator(PPreparator):
@@ -409,8 +420,16 @@ class RatingsPreparator(PPreparator):
             vals = np.asarray(td.values, dtype=np.float32)
         n_u, n_i = len(user_map), len(item_map)
         max_len = getattr(self.params, "max_len", None)
-        user_side = pad_ratings(rows, cols, vals, n_u, n_i, max_len=max_len)
-        item_side = pad_ratings(cols, rows, vals, n_i, n_u, max_len=max_len)
+        if getattr(self.params, "bucketed", False):
+            from predictionio_tpu.ops.als import bucket_ratings_pair
+
+            user_side, item_side = bucket_ratings_pair(
+                rows, cols, vals, n_u, n_i, max_len=max_len)
+        else:
+            user_side = pad_ratings(rows, cols, vals, n_u, n_i,
+                                    max_len=max_len)
+            item_side = pad_ratings(cols, rows, vals, n_i, n_u,
+                                    max_len=max_len)
         # per-user seen-item lists via one stable sort (vs n_u boolean scans)
         order = np.argsort(rows, kind="stable")
         s_rows, s_cols = rows[order], cols[order]

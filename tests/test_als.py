@@ -40,9 +40,10 @@ class TestPadding:
         assert pr.cols.shape == pr.weights.shape == (4, 8)  # padded to 8
         # row 1 empty -> all zero weights
         assert pr.weights[1].sum() == 0
-        # row 2 has its three ratings, heaviest first
+        # row 2 has its three ratings (column order — heaviest-first
+        # ordering applies only when a max_len cut is active)
         assert sorted(pr.weights[2][pr.weights[2] > 0].tolist()) == [3, 4, 5]
-        assert pr.weights[2][0] == 5.0
+        assert pr.weights[2][:3].tolist() == [3.0, 4.0, 5.0]
 
     def test_duplicates_are_summed(self):
         # reduceByKey(_ + _) parity (custom-query ALSAlgorithm.scala:50)

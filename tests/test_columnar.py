@@ -166,3 +166,64 @@ class TestTemplateWiring:
         assert len(td) == 2
         assert list(td.users) == ["u1", "u2"]
         np.testing.assert_allclose(td.values, [2.0, 3.0])
+
+
+class TestThreadedBlockIterator:
+    def test_yields_all_blocks_in_order(self):
+        from predictionio_tpu.data.columnar import iter_blocks_threaded
+
+        got = list(iter_blocks_threaded(iter(range(20)), queue_size=3))
+        assert got == list(range(20))
+
+    def test_producer_exception_reraised(self):
+        from predictionio_tpu.data.columnar import iter_blocks_threaded
+
+        def boom():
+            yield 1
+            raise ValueError("decode failed")
+
+        it = iter_blocks_threaded(boom())
+        assert next(it) == 1
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="decode failed"):
+            list(it)
+
+    def test_bounded_queue_backpressure(self):
+        import threading
+        from predictionio_tpu.data.columnar import iter_blocks_threaded
+
+        produced = []
+
+        def gen():
+            for i in range(10):
+                produced.append(i)
+                yield i
+
+        it = iter_blocks_threaded(gen(), queue_size=2)
+        first = next(it)
+        assert first == 0
+        # producer can be at most queue_size + 1 ahead of the consumer
+        import time
+        time.sleep(0.1)
+        assert len(produced) <= 1 + 2 + 1
+        assert list(it) == list(range(1, 10))
+
+    def test_early_consumer_exit_stops_producer(self):
+        import threading
+        import time
+        from predictionio_tpu.data.columnar import iter_blocks_threaded
+
+        produced = []
+
+        def gen():
+            for i in range(1000):
+                produced.append(i)
+                yield i
+
+        it = iter_blocks_threaded(gen(), queue_size=2)
+        assert next(it) == 0
+        it.close()  # consumer abandons the stream
+        time.sleep(0.3)
+        names = [t.name for t in threading.enumerate()]
+        assert "pio-block-decode" not in names
+        assert len(produced) < 1000

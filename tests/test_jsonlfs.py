@@ -414,3 +414,63 @@ class TestStreamingTrainE2E:
             assert 0 < len(res.item_scores) <= 3
         finally:
             storage.reset()
+
+    def test_streaming_plus_bucketed_preparator(self, tmp_path):
+        """The full scale recipe: jsonlfs store -> threaded streaming
+        blocks -> bucketed layout -> sharded-capable training -> serve.
+        The model must match the uniform-layout model's predictions."""
+        from predictionio_tpu.controller import ComputeContext, EngineParams
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.ops.als import ALSParams
+        from predictionio_tpu.templates.recommendation import (
+            DataSourceParams, PreparatorParams, Query, engine_factory,
+        )
+
+        cfg = storage.StorageConfig(
+            sources={"EV": {"type": "jsonlfs",
+                            "path": str(tmp_path / "events"),
+                            "part_max_events": 50},
+                     "META": {"type": "memory"}},
+            repositories={"EVENTDATA": "EV", "METADATA": "META",
+                          "MODELDATA": "META"})
+        storage.reset(cfg)
+        try:
+            aid = storage.get_metadata_apps().insert(App(0, "bigapp"))
+            le = storage.get_levents()
+            le.init(aid)
+            rng = np.random.default_rng(2)
+            evs = [Event(
+                event="rate", entity_type="user",
+                entity_id=f"u{rng.integers(0, 25)}",
+                target_entity_type="item",
+                target_entity_id=f"i{rng.integers(0, 15)}",
+                properties={"rating": float(rng.integers(1, 6))},
+                event_time=t(i)) for i in range(200)]
+            le.insert_batch(evs, aid)
+
+            engine = engine_factory()
+
+            def run(prep_params):
+                params = EngineParams(
+                    data_source_params=("", DataSourceParams(
+                        app_name="bigapp", streaming_block_size=64)),
+                    preparator_params=("", prep_params),
+                    algorithm_params_list=[
+                        ("als", ALSParams(rank=4, num_iterations=2,
+                                          seed=0))])
+                persistable = engine.train(ComputeContext(), params, "x")
+                [model] = engine.prepare_deploy(ComputeContext(), params,
+                                                "x", persistable)
+                algo = engine._algorithms(params)[0]
+                return algo.predict(model, Query(user="u1", num=5))
+
+            bucketed = run(PreparatorParams(bucketed=True))
+            uniform = run(PreparatorParams())
+            assert [s.item for s in bucketed.item_scores] == \
+                [s.item for s in uniform.item_scores]
+            np.testing.assert_allclose(
+                [s.score for s in bucketed.item_scores],
+                [s.score for s in uniform.item_scores], rtol=1e-3)
+        finally:
+            storage.reset()

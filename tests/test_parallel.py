@@ -57,6 +57,44 @@ class TestShardedALS:
             mesh_2d(16, 16)
 
 
+class TestShardedBucketedALS:
+    def test_matches_single_device_numerics(self, mesh8):
+        from predictionio_tpu.ops.als import bucket_ratings_pair
+        from predictionio_tpu.parallel.als_sharding import (
+            train_als_bucketed_sharded,
+        )
+
+        rows, cols, vals = synthetic_ratings(50, 30, 4, 0.3)
+        params = ALSParams(rank=6, num_iterations=4, lambda_=0.05, seed=5)
+        X1, Y1 = train_als(pad_ratings(rows, cols, vals, 50, 30),
+                           pad_ratings(cols, rows, vals, 30, 50), params)
+        ub, ib = bucket_ratings_pair(rows, cols, vals, 50, 30)
+        X8, Y8 = train_als_bucketed_sharded(ub, ib, params, mesh8)
+        assert X8.shape == X1.shape and Y8.shape == Y1.shape
+        np.testing.assert_allclose(X8, X1, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(Y8, Y1, rtol=1e-4, atol=1e-5)
+
+    def test_auto_dispatches_bucketed(self, mesh8):
+        from predictionio_tpu.ops.als import bucket_ratings_pair
+        from predictionio_tpu.parallel.als_sharding import train_als_auto
+
+        rows, cols, vals = synthetic_ratings(20, 12, 3, 0.4, seed=3)
+        params = ALSParams(rank=4, num_iterations=2, seed=0)
+        ub, ib = bucket_ratings_pair(rows, cols, vals, 20, 12)
+        Xa, Ya = train_als_auto(ub, ib, params)
+        X1, Y1 = train_als(pad_ratings(rows, cols, vals, 20, 12),
+                           pad_ratings(cols, rows, vals, 12, 20), params)
+        np.testing.assert_allclose(Xa, X1, rtol=1e-4, atol=1e-5)
+
+    def test_uniform_flavors_reject_bucketed_sides(self, mesh8):
+        from predictionio_tpu.ops.als import bucket_ratings_pair
+
+        rows, cols, vals = synthetic_ratings(10, 8, 2, 0.4, seed=4)
+        ub, ib = bucket_ratings_pair(rows, cols, vals, 10, 8)
+        with pytest.raises(TypeError, match="bucketed"):
+            train_als_sharded(ub, ib, ALSParams(rank=4), mesh8)
+
+
 class TestShardedALS2D:
     """Factor matrices sharded over the model axis (the ALX layout)."""
 
