@@ -49,10 +49,19 @@ DEFAULT_QUERY_LIMIT = 20   # EventServer.scala:352
 
 @dataclasses.dataclass
 class EventServerConfig:
-    """EventServerConfig (EventServer.scala:572-576)."""
+    """EventServerConfig (EventServer.scala:572-576).
+
+    ``service_key`` additionally enables the ``/storage/*`` wire: the
+    remote-DAO lane the ``resthttp`` storage backend speaks, so training
+    on one machine can read events served from another — the
+    architecture ``Storage.scala:360-391`` gets from remote HBase/JDBC
+    services. It is a storage credential (the analog of the DB password
+    in the reference's storage config), distinct from per-app access
+    keys; unset = the wire is disabled."""
     ip: str = "0.0.0.0"
     port: int = 7070
     stats: bool = False
+    service_key: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -316,6 +325,144 @@ class EventServer:
         return 404, {"message":
                      f"webhooks connection for {name} is not supported."}
 
+    # -- storage wire (/storage/*, service-key authed) ---------------------
+    # The remote-DAO lane: the `resthttp` backend's LEvents/PEvents client
+    # speaks these routes, so engines train against THIS server's event
+    # store from another machine/process (Storage.scala:360-391 remote-DAO
+    # architecture; bulk reads are the HBPEvents.scala:83-89 analog —
+    # partition bytes shipped raw, decoded client-side by the native
+    # codec). The service key is a storage credential like the
+    # reference's DB password: callers are trusted peers, and the append
+    # lane takes pre-validated JSONL (the client DAO validates before
+    # serializing, as the jsonlfs fast lane does).
+
+    def storage_auth(self, query: Dict[str, List[str]]) -> None:
+        import hmac
+
+        sk = self.config.service_key
+        if not sk:
+            raise _HttpError(403, {
+                "message": "storage wire disabled — start the event "
+                           "server with a service key"})
+        given = _first(query, "serviceKey") or ""
+        if not hmac.compare_digest(given, sk):
+            raise _HttpError(401, {"message": "Invalid serviceKey."})
+
+    @staticmethod
+    def _storage_scope(query) -> Tuple[int, Optional[int]]:
+        app_id = _first(query, "appId")
+        if app_id is None:
+            raise _HttpError(400, {"message": "appId is required"})
+        ch = _first(query, "channelId")
+        return int(app_id), (int(ch) if ch is not None else None)
+
+    def storage_init(self, query) -> Tuple[int, Any]:
+        app_id, ch = self._storage_scope(query)
+        return 200, {"ok": bool(self.event_client.init(app_id, ch))}
+
+    def storage_remove(self, query) -> Tuple[int, Any]:
+        app_id, ch = self._storage_scope(query)
+        return 200, {"ok": bool(self.event_client.remove(app_id, ch))}
+
+    def storage_append(self, query, body: bytes) -> Tuple[int, Any]:
+        app_id, ch = self._storage_scope(query)
+        lines = [ln for ln in body.decode("utf-8").split("\n")
+                 if ln.strip()]
+        le = self.event_client
+        if hasattr(le, "append_raw_lines"):
+            le.append_raw_lines(lines, app_id, ch)
+        else:
+            le.insert_batch([Event.from_json(ln) for ln in lines],
+                            app_id, ch)
+        return 200, {"count": len(lines)}
+
+    def storage_get_event(self, query, event_id: str) -> Tuple[int, Any]:
+        app_id, ch = self._storage_scope(query)
+        e = self.event_client.get(event_id, app_id, ch)
+        if e is None:
+            return 404, {"message": "Not Found"}
+        return 200, e.to_dict()
+
+    def storage_delete_event(self, query, event_id: str) -> Tuple[int, Any]:
+        app_id, ch = self._storage_scope(query)
+        return 200, {"found": bool(
+            self.event_client.delete(event_id, app_id, ch))}
+
+    def storage_delete_until(self, query) -> Tuple[int, Any]:
+        from predictionio_tpu.data.event import _parse_time
+
+        app_id, ch = self._storage_scope(query)
+        until = _parse_time(_first(query, "untilTime"))
+        if until is None:
+            return 400, {"message": "untilTime is required"}
+        return 200, {"removed":
+                     self.event_client.delete_until(app_id, until, ch)}
+
+    _STORAGE_FILTER_KEYS = ("startTime", "untilTime", "entityType",
+                            "entityId", "event", "targetEntityType",
+                            "targetEntityTypeNull", "targetEntityId",
+                            "targetEntityIdNull", "limit", "reversed")
+
+    def storage_stream(self, query):
+        """Yield event-JSONL byte chunks for a bulk read.
+
+        Fast lane: when the underlying store is jsonlfs and no content
+        filter is requested, the partition files ARE the wire format —
+        raw bytes go out with zero parsing. Otherwise events stream
+        through the underlying ``find``."""
+        app_id, ch = self._storage_scope(query)
+        unfiltered = not any(k in query for k in self._STORAGE_FILTER_KEYS)
+        le = self.event_client
+        from predictionio_tpu.data.storage.jsonlfs import JsonlFsLEvents
+
+        if unfiltered and isinstance(le, JsonlFsLEvents):
+            d = le._dir(app_id, ch)
+            def raw_parts():
+                for part in le._parts(d):
+                    with open(part, "rb") as f:
+                        while True:
+                            chunk = f.read(1 << 22)
+                            if not chunk:
+                                break
+                            yield chunk
+            return raw_parts()
+
+        from predictionio_tpu.data.event import _parse_time
+
+        tet = _first(query, "targetEntityType")
+        if _first(query, "targetEntityTypeNull") == "true":
+            tet = None
+        elif tet is None:
+            tet = UNSET
+        tei = _first(query, "targetEntityId")
+        if _first(query, "targetEntityIdNull") == "true":
+            tei = None
+        elif tei is None:
+            tei = UNSET
+        limit_s = _first(query, "limit")
+        events = le.find(
+            app_id=app_id, channel_id=ch,
+            start_time=_parse_time(_first(query, "startTime")),
+            until_time=_parse_time(_first(query, "untilTime")),
+            entity_type=_first(query, "entityType"),
+            entity_id=_first(query, "entityId"),
+            event_names=query.get("event") or None,
+            target_entity_type=tet, target_entity_id=tei,
+            limit=int(limit_s) if limit_s is not None else None,
+            reversed=_first(query, "reversed") == "true",
+        )
+
+        def serialized():
+            buf: List[str] = []
+            for e in events:
+                buf.append(e.to_json())
+                if len(buf) >= 2000:
+                    yield ("\n".join(buf) + "\n").encode("utf-8")
+                    buf.clear()
+            if buf:
+                yield ("\n".join(buf) + "\n").encode("utf-8")
+        return serialized()
+
 
 def _first(query: Dict[str, List[str]], key: str) -> Optional[str]:
     vals = query.get(key)
@@ -369,6 +516,25 @@ class _EventHandler(BaseHTTPRequestHandler):
     def _body(self) -> bytes:
         return self._request_body
 
+    def _respond_chunked(self, status: int, chunks) -> None:
+        """Stream an unbounded byte-chunk iterator (Transfer-Encoding:
+        chunked). A failure after the headers go out aborts the
+        connection (``_stream_started`` tells ``_dispatch`` a second
+        response is impossible) — the client sees a truncated chunked
+        stream and raises, never silently-short data."""
+        self.send_response(status)
+        self.send_header("Content-Type", "application/x-jsonlines")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        self._stream_started = True
+        for c in chunks:
+            if not c:
+                continue
+            self.wfile.write(f"{len(c):x}\r\n".encode("ascii"))
+            self.wfile.write(c)
+            self.wfile.write(b"\r\n")
+        self.wfile.write(b"0\r\n\r\n")
+
     def _dispatch(self, method: str) -> None:
         srv = self.event_server
         parsed = urllib.parse.urlsplit(self.path)
@@ -386,13 +552,26 @@ class _EventHandler(BaseHTTPRequestHandler):
             if path == "/plugins.json" and method == "GET":
                 self._respond(200, srv.plugin_context.describe())
                 return
+            if path.startswith("/storage/"):
+                srv.storage_auth(query)
+                self._storage_route(srv, method, path, query)
+                return
             auth = srv.authenticate(query, self.headers)
             status, payload = self._route(srv, method, path, query, auth)
             self._respond(status, payload)
         except _HttpError as e:
+            if getattr(self, "_stream_started", False):
+                self.close_connection = True
+                return
             self._respond(e.status, e.payload)
         except Exception as e:
             logger.exception("unhandled error on %s %s", method, path)
+            if getattr(self, "_stream_started", False):
+                # mid-stream failure: a second status line would corrupt
+                # the chunked framing — abort so the client sees a
+                # truncated stream and raises
+                self.close_connection = True
+                return
             self._respond(500, {"message": str(e)})
 
     def _route(self, srv: EventServer, method: str, path: str,
@@ -437,6 +616,34 @@ class _EventHandler(BaseHTTPRequestHandler):
                 return 200, json.loads(
                     plugin.handle_rest(auth.app_id, auth.channel_id, args))
         return 404, {"message": "Not Found"}
+
+    def _storage_route(self, srv: EventServer, method: str, path: str,
+                       query: Dict[str, List[str]]) -> None:
+        if path == "/storage/events.jsonl":
+            if method == "GET":
+                self._respond_chunked(200, srv.storage_stream(query))
+                return
+            if method == "POST":
+                self._respond(*srv.storage_append(query, self._body()))
+                return
+        elif path == "/storage/init.json" and method == "POST":
+            self._respond(*srv.storage_init(query))
+            return
+        elif path == "/storage/remove.json" and method == "POST":
+            self._respond(*srv.storage_remove(query))
+            return
+        elif path == "/storage/delete_until.json" and method == "POST":
+            self._respond(*srv.storage_delete_until(query))
+            return
+        elif path.startswith("/storage/events/") and path.endswith(".json"):
+            event_id = path[len("/storage/events/"):-len(".json")]
+            if method == "GET":
+                self._respond(*srv.storage_get_event(query, event_id))
+                return
+            if method == "DELETE":
+                self._respond(*srv.storage_delete_event(query, event_id))
+                return
+        self._respond(404, {"message": "Not Found"})
 
     def do_GET(self):
         self._dispatch("GET")

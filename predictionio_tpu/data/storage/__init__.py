@@ -63,6 +63,14 @@ BACKENDS: Dict[str, Dict[str, str]] = {
         "LEvents": "predictionio_tpu.data.storage.jsonlfs:JsonlFsLEvents",
         "PEvents": "predictionio_tpu.data.storage.jsonlfs:JsonlFsPEvents",
     },
+    # EVENTDATA-only networked backend: DAOs speak HTTP to a remote
+    # event server's /storage wire (the Storage.scala:360-391 remote-DAO
+    # architecture — train on one machine, store on another); config
+    # keys: URL, SERVICE_KEY, TIMEOUT
+    "resthttp": {
+        "LEvents": "predictionio_tpu.data.storage.resthttp:RestLEvents",
+        "PEvents": "predictionio_tpu.data.storage.resthttp:RestPEvents",
+    },
 }
 
 

@@ -428,129 +428,145 @@ class JsonlFsPEvents(base.LEventsBackedPEvents):
     def _decode_part(self, data: bytes, *, start_time, until_time,
                      entity_type, event_names, target_entity_type,
                      value_property, default_value, strict, source: str):
-        """bytes -> list of filtered ColumnarEvents, native codec first.
-        The string columns come back DICTIONARY-ENCODED (int32 codes +
-        distinct labels), so filtering is pure numpy over codes and no
-        per-event Python strings exist — the 10M-row fast lane. Fallback
-        rows (lines the codec punted on) come back as a separate small
-        object-form block so they never de-optimize the encoded bulk."""
-        from predictionio_tpu.data.columnar import (
-            ColumnarEvents,
-            events_to_columnar,
-        )
-        from predictionio_tpu.native import codec
+        return decode_jsonl_events(
+            data, start_time=start_time, until_time=until_time,
+            entity_type=entity_type, event_names=event_names,
+            target_entity_type=target_entity_type,
+            value_property=value_property, default_value=default_value,
+            strict=strict, source=source)
 
-        enc = {codec.COL_EVENT, codec.COL_ENTITY_ID,
-               codec.COL_TARGET_ENTITY_ID}
-        # type columns are only worth an O(n) encode pass when their
-        # filters are active
-        if entity_type is not None:
-            enc.add(codec.COL_ENTITY_TYPE)
-        if target_entity_type is not UNSET:
-            enc.add(codec.COL_TARGET_ENTITY_TYPE)
-        parsed = codec.parse_jsonl(
-            data, numeric_property=value_property, dict_encode=enc,
-            # the only per-row strings materialized: raw eventTime text,
-            # needed just for rows whose time the C++ parser punted on
-            columns={codec.COL_EVENT_TIME_RAW})
-        if parsed is None:  # no native lib: python oracle on the whole part
-            events = [e for ln in data.decode("utf-8").splitlines()
-                      if ln.strip()
-                      and (e := _parse_event_line(ln, source)) is not None]
-            kept = [e for e in events
-                    if match_event(e, start_time, until_time, entity_type,
-                                   None, event_names, target_entity_type,
-                                   UNSET)]
-            return [events_to_columnar(kept, value_property=value_property,
-                                       default_value=default_value,
-                                       strict=strict)]
 
-        flags = parsed.flags
-        keep = (flags & codec.FALLBACK) == 0
+def decode_jsonl_events(data: bytes, *, start_time=None, until_time=None,
+                        entity_type=None, event_names=None,
+                        target_entity_type=UNSET, value_property=None,
+                        default_value=1.0, strict=True,
+                        source: str = "<bytes>"):
+    """Event-JSONL bytes -> list of filtered ColumnarEvents, native codec
+    first. The string columns come back DICTIONARY-ENCODED (int32 codes +
+    distinct labels), so filtering is pure numpy over codes and no
+    per-event Python strings exist — the 10M-row fast lane. Fallback
+    rows (lines the codec punted on) come back as a separate small
+    object-form block so they never de-optimize the encoded bulk.
 
-        def code_filter(col: int, wanted: set) -> np.ndarray:
-            """Rows whose encoded column value is in ``wanted`` — a label
-            scan over the (tiny) distinct set + one vector isin."""
-            labels = parsed.dict_labels[col]
-            codes = parsed.dict_codes[col]
-            want = np.asarray([j for j, lab in enumerate(labels)
-                               if lab in wanted], dtype=np.int32)
-            return np.isin(codes, want)
+    Shared by the jsonlfs partition scan and the resthttp client (which
+    ships partition bytes over the wire and decodes them here)."""
+    from predictionio_tpu.data.columnar import (
+        ColumnarEvents,
+        events_to_columnar,
+    )
+    from predictionio_tpu.native import codec
 
-        if event_names is not None:
-            keep &= code_filter(codec.COL_EVENT, set(event_names))
-        if entity_type is not None:
-            keep &= code_filter(codec.COL_ENTITY_TYPE, {entity_type})
-        if target_entity_type is not UNSET:
-            tet = parsed.dict_codes[codec.COL_TARGET_ENTITY_TYPE]
-            if target_entity_type is None:
-                keep &= tet == -1
-            else:
-                keep &= code_filter(codec.COL_TARGET_ENTITY_TYPE,
-                                    {target_entity_type})
-
-        times = parsed.event_time.copy()
-        # rows the codec parsed but whose eventTime it could not (rare
-        # exotic formats): resolve via the python parser so time filters
-        # and ordering stay exact
-        nan_rows = np.nonzero(keep & np.isnan(times))[0]
-        if len(nan_rows):
-            from predictionio_tpu.data.event import _now, _parse_time
-
-            now_ts = _now().timestamp()
-            for i in nan_rows:
-                raw = parsed.event_time_raw[i]
-                t = _parse_time(raw) if raw is not None else None
-                times[i] = t.timestamp() if t is not None else now_ts
-        if start_time is not None:
-            keep &= times >= start_time.timestamp()
-        if until_time is not None:
-            keep &= times < until_time.timestamp()
-
-        idx = np.nonzero(keep)[0]
-        vals = np.full(len(idx), float(default_value), dtype=np.float32)
-        if value_property is not None and len(idx):
-            status = parsed.prop_status[idx]
-            if strict and (status == 2).any():
-                bad = idx[int(np.nonzero(status == 2)[0][0])]
-                raise ValueError(
-                    f"property {value_property!r} of event at "
-                    f"{source}:{int(parsed.lineno[bad])} is non-numeric")
-            numeric = status == 1
-            vals[numeric] = parsed.prop_value[idx][numeric].astype(
-                np.float32)
-        block = ColumnarEvents(
-            entity_ids=None,
-            target_ids=None,
-            values=vals,
-            event_times=times[idx],
-            entity_codes=parsed.dict_codes[codec.COL_ENTITY_ID][idx],
-            entity_labels=parsed.dict_labels[codec.COL_ENTITY_ID],
-            target_codes=parsed.dict_codes[
-                codec.COL_TARGET_ENTITY_ID][idx],
-            target_labels=parsed.dict_labels[codec.COL_TARGET_ENTITY_ID],
-            event_codes=parsed.dict_codes[codec.COL_EVENT][idx],
-            event_labels=parsed.dict_labels[codec.COL_EVENT],
-        )
-
-        out = [block]
-        # fallback rows: the python oracle re-parses those exact lines
-        # into their own small block
-        fb_rows = np.nonzero((flags & codec.FALLBACK) != 0)[0]
-        if len(fb_rows):
-            events = []
-            for i in fb_rows:
-                raw = data[parsed.line_start[i]:parsed.line_end[i]] \
-                    .decode("utf-8", errors="replace").strip()
-                e = _parse_event_line(raw, source)
-                if e is None:
-                    continue
+    enc = {codec.COL_EVENT, codec.COL_ENTITY_ID,
+           codec.COL_TARGET_ENTITY_ID}
+    # type columns are only worth an O(n) encode pass when their
+    # filters are active
+    if entity_type is not None:
+        enc.add(codec.COL_ENTITY_TYPE)
+    if target_entity_type is not UNSET:
+        enc.add(codec.COL_TARGET_ENTITY_TYPE)
+    parsed = codec.parse_jsonl(
+        data, numeric_property=value_property, dict_encode=enc,
+        # the only per-row strings materialized: raw eventTime text,
+        # needed just for rows whose time the C++ parser punted on
+        columns={codec.COL_EVENT_TIME_RAW})
+    if parsed is None:  # no native lib: python oracle on the whole part
+        events = [e for ln in data.decode("utf-8").splitlines()
+                  if ln.strip()
+                  and (e := _parse_event_line(ln, source)) is not None]
+        kept = [e for e in events
                 if match_event(e, start_time, until_time, entity_type,
                                None, event_names, target_entity_type,
-                               UNSET):
-                    events.append(e)
-            if events:
-                out.append(events_to_columnar(
-                    events, value_property=value_property,
-                    default_value=default_value, strict=strict))
-        return out
+                               UNSET)]
+        return [events_to_columnar(kept, value_property=value_property,
+                                   default_value=default_value,
+                                   strict=strict)]
+
+    flags = parsed.flags
+    keep = (flags & codec.FALLBACK) == 0
+
+    def code_filter(col: int, wanted: set) -> np.ndarray:
+        """Rows whose encoded column value is in ``wanted`` — a label
+        scan over the (tiny) distinct set + one vector isin."""
+        labels = parsed.dict_labels[col]
+        codes = parsed.dict_codes[col]
+        want = np.asarray([j for j, lab in enumerate(labels)
+                           if lab in wanted], dtype=np.int32)
+        return np.isin(codes, want)
+
+    if event_names is not None:
+        keep &= code_filter(codec.COL_EVENT, set(event_names))
+    if entity_type is not None:
+        keep &= code_filter(codec.COL_ENTITY_TYPE, {entity_type})
+    if target_entity_type is not UNSET:
+        tet = parsed.dict_codes[codec.COL_TARGET_ENTITY_TYPE]
+        if target_entity_type is None:
+            keep &= tet == -1
+        else:
+            keep &= code_filter(codec.COL_TARGET_ENTITY_TYPE,
+                                {target_entity_type})
+
+    times = parsed.event_time.copy()
+    # rows the codec parsed but whose eventTime it could not (rare
+    # exotic formats): resolve via the python parser so time filters
+    # and ordering stay exact
+    nan_rows = np.nonzero(keep & np.isnan(times))[0]
+    if len(nan_rows):
+        from predictionio_tpu.data.event import _now, _parse_time
+
+        now_ts = _now().timestamp()
+        for i in nan_rows:
+            raw = parsed.event_time_raw[i]
+            t = _parse_time(raw) if raw is not None else None
+            times[i] = t.timestamp() if t is not None else now_ts
+    if start_time is not None:
+        keep &= times >= start_time.timestamp()
+    if until_time is not None:
+        keep &= times < until_time.timestamp()
+
+    idx = np.nonzero(keep)[0]
+    vals = np.full(len(idx), float(default_value), dtype=np.float32)
+    if value_property is not None and len(idx):
+        status = parsed.prop_status[idx]
+        if strict and (status == 2).any():
+            bad = idx[int(np.nonzero(status == 2)[0][0])]
+            raise ValueError(
+                f"property {value_property!r} of event at "
+                f"{source}:{int(parsed.lineno[bad])} is non-numeric")
+        numeric = status == 1
+        vals[numeric] = parsed.prop_value[idx][numeric].astype(
+            np.float32)
+    block = ColumnarEvents(
+        entity_ids=None,
+        target_ids=None,
+        values=vals,
+        event_times=times[idx],
+        entity_codes=parsed.dict_codes[codec.COL_ENTITY_ID][idx],
+        entity_labels=parsed.dict_labels[codec.COL_ENTITY_ID],
+        target_codes=parsed.dict_codes[
+            codec.COL_TARGET_ENTITY_ID][idx],
+        target_labels=parsed.dict_labels[codec.COL_TARGET_ENTITY_ID],
+        event_codes=parsed.dict_codes[codec.COL_EVENT][idx],
+        event_labels=parsed.dict_labels[codec.COL_EVENT],
+    )
+
+    out = [block]
+    # fallback rows: the python oracle re-parses those exact lines
+    # into their own small block
+    fb_rows = np.nonzero((flags & codec.FALLBACK) != 0)[0]
+    if len(fb_rows):
+        events = []
+        for i in fb_rows:
+            raw = data[parsed.line_start[i]:parsed.line_end[i]] \
+                .decode("utf-8", errors="replace").strip()
+            e = _parse_event_line(raw, source)
+            if e is None:
+                continue
+            if match_event(e, start_time, until_time, entity_type,
+                           None, event_names, target_entity_type,
+                           UNSET):
+                events.append(e)
+        if events:
+            out.append(events_to_columnar(
+                events, value_property=value_property,
+                default_value=default_value, strict=strict))
+    return out

@@ -200,6 +200,11 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--ip", default="0.0.0.0")
     es.add_argument("--port", type=int, default=7070)
     es.add_argument("--stats", action="store_true")
+    es.add_argument(
+        "--service-key", default=None, metavar="KEY",
+        help="enable the /storage wire for remote resthttp storage "
+             "clients (a storage credential, like a DB password; env "
+             "PIO_EVENTSERVER_SERVICE_KEY)")
     es.set_defaults(func=run_commands.cmd_eventserver)
 
     adm = sub.add_parser("adminserver", help="start the admin REST server")

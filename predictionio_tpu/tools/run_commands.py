@@ -214,10 +214,15 @@ def cmd_undeploy(args) -> int:
 
 def cmd_eventserver(args) -> int:
     """Console eventserver (Console.scala:741-745)."""
+    import os
+
     from predictionio_tpu.data.api import EventServer, EventServerConfig
 
+    service_key = getattr(args, "service_key", None) \
+        or os.environ.get("PIO_EVENTSERVER_SERVICE_KEY") or None
     server = EventServer(EventServerConfig(
-        ip=args.ip, port=args.port, stats=args.stats)).start()
+        ip=args.ip, port=args.port, stats=args.stats,
+        service_key=service_key)).start()
     host, port = server.address
     print(f"[INFO] Event Server is ready at http://{host}:{port}.")
     try:

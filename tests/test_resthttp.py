@@ -1,0 +1,192 @@
+"""resthttp networked storage backend: training decoupled from the event
+store's disk. An event server runs in a SEPARATE PROCESS holding the
+events in its own directory; the engine trains against it through the
+`resthttp` EVENTDATA source (Storage.scala:360-391 remote-DAO
+architecture; bulk reads are the HBPEvents.scala:83-89 remote-scan
+analog, decoded client-side by the native codec)."""
+
+import datetime as dt
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import StorageError
+from predictionio_tpu.data.storage.resthttp import RestLEvents, RestPEvents
+
+UTC = dt.timezone.utc
+KEY = "wire-secret"
+
+
+def t(i):
+    return dt.datetime(2021, 3, 1, tzinfo=UTC) + dt.timedelta(seconds=int(i))
+
+
+@pytest.fixture(scope="module")
+def remote_server(tmp_path_factory):
+    """A real `pio eventserver --service-key` child process with its own
+    store directory — nothing shared with the training side but the
+    TCP port."""
+    root = tmp_path_factory.mktemp("remote_store")
+    env = dict(os.environ)
+    env.update({
+        "PIO_STORAGE_SOURCES_EV_TYPE": "jsonlfs",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(root / "events"),
+        "PIO_STORAGE_SOURCES_EV_PART_MAX_EVENTS": "64",
+        "PIO_STORAGE_SOURCES_META_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "META",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "META",
+        "JAX_PLATFORMS": "cpu",
+    })
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.tools.console",
+         "eventserver", "--ip", "127.0.0.1", "--port", str(port),
+         "--service-key", KEY],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{port}"
+    for _ in range(100):
+        try:
+            with urllib.request.urlopen(url + "/", timeout=1):
+                break
+        except Exception:
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode()
+                raise RuntimeError(f"eventserver died:\n{out}")
+            time.sleep(0.1)
+    else:
+        proc.kill()
+        raise RuntimeError("eventserver never became ready")
+    yield url
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+@pytest.fixture
+def wire(remote_server):
+    return {"url": remote_server, "service_key": KEY}
+
+
+class TestWireBasics:
+    def test_wrong_service_key_rejected(self, remote_server):
+        le = RestLEvents({"url": remote_server, "service_key": "nope"})
+        with pytest.raises(StorageError, match="serviceKey"):
+            le.init(1)
+
+    def test_wire_disabled_without_server_key(self, mem_storage):
+        from predictionio_tpu.data.api.event_server import (
+            EventServer, EventServerConfig,
+        )
+
+        server = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0)).start()
+        try:
+            host, port = server.address
+            le = RestLEvents({"url": f"http://{host}:{port}",
+                              "service_key": "anything"})
+            with pytest.raises(StorageError, match="disabled"):
+                le.init(1)
+        finally:
+            server.stop()
+
+    def test_crud_roundtrip(self, wire):
+        le = RestLEvents(wire)
+        le.init(50)
+        eid = le.insert(Event(event="rate", entity_type="user",
+                              entity_id="u1", target_entity_type="item",
+                              target_entity_id="i1",
+                              properties={"rating": 4.0},
+                              event_time=t(0)), 50)
+        got = le.get(eid, 50)
+        assert got is not None and got.properties.get("rating") == 4.0
+        assert le.delete(eid, 50)
+        assert le.get(eid, 50) is None
+        le.remove(50)
+
+    def test_columnar_blocks_match_typed_reads(self, wire):
+        le = RestLEvents(wire)
+        le.init(60)
+        rng = np.random.default_rng(0)
+        evs = [Event(event="rate", entity_type="user",
+                     entity_id=f"u{rng.integers(0, 12)}",
+                     target_entity_type="item",
+                     target_entity_id=f"i{rng.integers(0, 8)}",
+                     properties={"rating": float(rng.integers(1, 6))},
+                     event_time=t(i)) for i in range(300)]
+        le.insert_batch(evs, 60)
+        pe = RestPEvents(wire)
+        blocks = list(pe.find_columnar_blocks(
+            60, event_names=["rate"], value_property="rating",
+            block_size=77))
+        assert all(len(b) <= 77 for b in blocks)
+        assert sum(len(b) for b in blocks) == 300
+        batch = pe.find_columnar(60, value_property="rating")
+        assert len(batch) == 300
+        assert np.all(np.diff(batch.event_times) >= 0)
+        got = sorted(zip(batch.entity_ids.tolist(),
+                         batch.target_ids.tolist(),
+                         batch.values.tolist()))
+        want = sorted((e.entity_id, e.target_entity_id,
+                       e.properties.get("rating")) for e in evs)
+        assert got == want
+        le.remove(60)
+
+
+class TestRemoteTraining:
+    def test_template_trains_against_remote_process(self, wire,
+                                                    remote_server):
+        """The round-5 architecture goal: engine + model on this side,
+        events served by a different process from a different
+        directory; streaming bucketed training over the wire."""
+        from predictionio_tpu.controller import ComputeContext, EngineParams
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.ops.als import ALSParams
+        from predictionio_tpu.templates.recommendation import (
+            DataSourceParams, PreparatorParams, Query, engine_factory,
+        )
+
+        cfg = storage.StorageConfig(
+            sources={"REMOTE": {"type": "resthttp", **wire},
+                     "LOCAL": {"type": "memory"}},
+            repositories={"EVENTDATA": "REMOTE", "METADATA": "LOCAL",
+                          "MODELDATA": "LOCAL"})
+        storage.reset(cfg)
+        try:
+            aid = storage.get_metadata_apps().insert(App(0, "remoteapp"))
+            le = storage.get_levents()
+            le.init(aid)
+            rng = np.random.default_rng(1)
+            le.insert_batch(
+                [Event(event="rate", entity_type="user",
+                       entity_id=f"u{rng.integers(0, 20)}",
+                       target_entity_type="item",
+                       target_entity_id=f"i{rng.integers(0, 12)}",
+                       properties={"rating": float(rng.integers(1, 6))},
+                       event_time=t(i)) for i in range(400)], aid)
+
+            engine = engine_factory()
+            params = EngineParams(
+                data_source_params=("", DataSourceParams(
+                    app_name="remoteapp", streaming_block_size=128)),
+                preparator_params=("", PreparatorParams(bucketed=True)),
+                algorithm_params_list=[
+                    ("als", ALSParams(rank=4, num_iterations=2, seed=0))])
+            persistable = engine.train(ComputeContext(), params, "r1")
+            [model] = engine.prepare_deploy(ComputeContext(), params,
+                                            "r1", persistable)
+            algo = engine._algorithms(params)[0]
+            res = algo.predict(model, Query(user="u1", num=3))
+            assert 0 < len(res.item_scores) <= 3
+        finally:
+            storage.reset()

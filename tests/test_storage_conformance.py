@@ -29,8 +29,42 @@ UTC = dt.timezone.utc
 APP = 1
 
 
-@pytest.fixture(params=["memory", "sqlite", "jsonlfs"])
+@pytest.fixture(params=["memory", "sqlite", "jsonlfs", "resthttp"])
 def backend(request, tmp_path):
+    if request.param == "resthttp":
+        # the networked lane: a live event server holding the data in
+        # its OWN directory, storage-wire DAOs speaking HTTP to it —
+        # the same behavior suite must pass over the wire
+        from predictionio_tpu.data import storage as storage_mod
+        from predictionio_tpu.data.api.event_server import (
+            EventServer, EventServerConfig,
+        )
+        from predictionio_tpu.data.storage.resthttp import RestLEvents
+
+        server_reg = storage_mod.StorageRegistry(storage_mod.StorageConfig(
+            sources={"EV": {"type": "jsonlfs",
+                            "path": str(tmp_path / "server_events"),
+                            "part_max_events": 3},
+                     "META": {"type": "memory"}},
+            repositories={"EVENTDATA": "EV", "METADATA": "META",
+                          "MODELDATA": "META"}))
+        server = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0,
+                              service_key="conf-secret"),
+            reg=server_reg).start()
+        host, port = server.address
+        cfg = {"url": f"http://{host}:{port}",
+               "service_key": "conf-secret"}
+        made = {
+            "levents": RestLEvents(cfg), "apps": MemApps({}),
+            "access_keys": MemAccessKeys({}), "channels": MemChannels({}),
+            "engine_instances": MemEngineInstances({}),
+            "evaluation_instances": MemEvaluationInstances({}),
+            "models": MemModels({}),
+        }
+        yield made
+        server.stop()
+        return
     if request.param == "jsonlfs":
         from predictionio_tpu.data.storage.jsonlfs import JsonlFsLEvents
 
@@ -62,7 +96,7 @@ def backend(request, tmp_path):
             "models": SqliteModels,
         }
         cfg = {"path": str(tmp_path / f"conf_{request.param}.db")}
-    return {k: v(cfg) for k, v in make.items()}
+    yield {k: v(cfg) for k, v in make.items()}
 
 
 def t(i):
