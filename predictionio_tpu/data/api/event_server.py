@@ -545,6 +545,10 @@ class _EventHandler(BaseHTTPRequestHandler):
         # clients would read garbage on the next pipelined request.
         length = int(self.headers.get("Content-Length") or 0)
         self._request_body = self.rfile.read(length) if length else b""
+        # per-REQUEST flag on a per-CONNECTION handler instance: a prior
+        # successful stream on this keep-alive connection must not make
+        # later errors close the socket instead of responding
+        self._stream_started = False
         try:
             if path == "/" and method == "GET":
                 self._respond(200, {"status": "alive"})
@@ -587,7 +591,8 @@ class _EventHandler(BaseHTTPRequestHandler):
         elif path == "/stats.json" and method == "GET":
             return srv.get_stats(auth)
         elif path.startswith("/events/") and path.endswith(".json"):
-            event_id = path[len("/events/"):-len(".json")]
+            event_id = urllib.parse.unquote(
+                path[len("/events/"):-len(".json")])
             if method == "GET":
                 return srv.get_event(auth, event_id)
             if method == "DELETE":
@@ -636,7 +641,9 @@ class _EventHandler(BaseHTTPRequestHandler):
             self._respond(*srv.storage_delete_until(query))
             return
         elif path.startswith("/storage/events/") and path.endswith(".json"):
-            event_id = path[len("/storage/events/"):-len(".json")]
+            # clients percent-encode ids with reserved characters
+            event_id = urllib.parse.unquote(
+                path[len("/storage/events/"):-len(".json")])
             if method == "GET":
                 self._respond(*srv.storage_get_event(query, event_id))
                 return

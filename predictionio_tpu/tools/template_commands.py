@@ -165,6 +165,28 @@ BUILTIN_TEMPLATES: Dict[str, Dict] = {
             }],
         },
     },
+    "textclassification": {
+        "description": "Text -> label: hashed embedding table + LR "
+                       "trained on device, NB over token counts "
+                       "(net-new; BASELINE.json configs[4])",
+        "engineFactory":
+            "predictionio_tpu.templates.textclassification"
+            ":engine_factory",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.textclassification"
+                ":engine_factory",
+            "datasource": {"params": {"appName": "INVALID_APP_NAME"}},
+            "preparator": {"params": {"vocabSize": 4096,
+                                      "maxTokens": 64}},
+            "algorithms": [{
+                "name": "lr",
+                "params": {"embeddingDim": 64, "epochs": 30, "seed": 0},
+            }],
+        },
+    },
 }
 
 

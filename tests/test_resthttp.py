@@ -99,6 +99,48 @@ class TestWireBasics:
         finally:
             server.stop()
 
+    def test_error_after_stream_on_same_connection(self, wire):
+        """Keep-alive regression: a successful stream must not make a
+        later failing request on the same connection die socket-closed
+        instead of getting its error JSON."""
+        import http.client
+        import json as _json
+        import urllib.parse as up
+
+        le = RestLEvents(wire)
+        le.init(70)
+        le.insert_batch([Event(event="rate", entity_type="user",
+                               entity_id="u1", event_time=t(0))], 70)
+        host = wire["url"].split("//")[1]
+        conn = http.client.HTTPConnection(host, timeout=10)
+        q = up.urlencode({"serviceKey": wire["service_key"], "appId": 70,
+                          "limit": -1})
+        conn.request("GET", f"/storage/events.jsonl?{q}")
+        r1 = conn.getresponse()
+        assert r1.status == 200
+        r1.read()
+        # same connection, bad key -> must get a 401 JSON, not a
+        # connection reset
+        conn.request("POST", "/storage/init.json?appId=70&serviceKey=no")
+        r2 = conn.getresponse()
+        assert r2.status == 401
+        assert "serviceKey" in _json.loads(r2.read())["message"]
+        conn.close()
+        le.remove(70)
+
+    def test_reserved_character_event_id_roundtrip(self, wire):
+        le = RestLEvents(wire)
+        le.init(71)
+        weird = "order/42?x=#1"
+        le.insert_batch([Event(event="rate", entity_type="user",
+                               entity_id="u1", event_id=weird,
+                               event_time=t(0))], 71)
+        got = le.get(weird, 71)
+        assert got is not None and got.event_id == weird
+        assert le.delete(weird, 71)
+        assert le.get(weird, 71) is None
+        le.remove(71)
+
     def test_crud_roundtrip(self, wire):
         le = RestLEvents(wire)
         le.init(50)

@@ -1,0 +1,177 @@
+"""Text-classification template: tokenize -> hashed embedding table ->
+LR on device (and NB over token counts), end to end through the DASE
+engine with events in the store."""
+
+import datetime as dt
+import pickle
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import ComputeContext, EngineParams
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.templates.textclassification import (
+    Accuracy,
+    DataSourceParams,
+    PreparatorParams,
+    Query,
+    TextLRParams,
+    TextNBParams,
+    TextPreparator,
+    TrainingData,
+    Document,
+    encode_texts,
+    engine_factory,
+    hash_tokens,
+    tokenize,
+)
+
+UTC = dt.timezone.utc
+
+
+def corpus(n_per_class=60, seed=0):
+    """Separable synthetic corpus: per-class signature vocabulary plus
+    shared noise words."""
+    rng = np.random.default_rng(seed)
+    vocab = {
+        "sports": [f"sport{i}" for i in range(25)],
+        "tech": [f"tech{i}" for i in range(25)],
+        "food": [f"food{i}" for i in range(25)],
+    }
+    noise = [f"the{i}" for i in range(15)]
+    docs = []
+    for label, words in vocab.items():
+        for _ in range(n_per_class):
+            n_sig = int(rng.integers(4, 10))
+            n_noise = int(rng.integers(2, 6))
+            toks = list(rng.choice(words, size=n_sig)) + \
+                list(rng.choice(noise, size=n_noise))
+            rng.shuffle(toks)
+            docs.append(Document(text=" ".join(toks), label=label))
+    rng.shuffle(docs)  # type: ignore[arg-type]
+    return docs
+
+
+class TestEncoding:
+    def test_tokenize(self):
+        assert tokenize("Hello, World! it's 2x FUN") == \
+            ["hello", "world", "it's", "2x", "fun"]
+
+    def test_hashing_stable_and_in_range(self):
+        h1 = hash_tokens(["alpha", "beta", "alpha"], 512)
+        h2 = hash_tokens(["alpha", "beta", "alpha"], 512)
+        assert np.array_equal(h1, h2)
+        assert h1[0] == h1[2] != h1[1]
+        assert (h1 >= 1).all() and (h1 < 512).all()  # 0 reserved for pad
+
+    def test_encode_pads_and_truncates(self):
+        ids, mask = encode_texts(["a b c", "", " ".join("w%d" % i
+                                                        for i in range(99))],
+                                 256, 8)
+        assert ids.shape == mask.shape == (3, 8)
+        assert mask[0].sum() == 3 and ids[0, 3:].sum() == 0
+        assert mask[1].sum() == 0
+        assert mask[2].sum() == 8  # truncated to max_tokens
+
+    def test_preparator_builds_label_dict(self):
+        prep = TextPreparator(PreparatorParams(vocab_size=128,
+                                               max_tokens=6))
+        pd = prep.prepare(ComputeContext(),
+                          TrainingData(corpus(n_per_class=4)))
+        assert pd.labels == ("food", "sports", "tech")
+        assert pd.token_ids.shape == (12, 6)
+        assert set(pd.label_codes.tolist()) == {0, 1, 2}
+
+
+def _train_engine(algo_name, algo_params, docs, prep=None):
+    from predictionio_tpu.data import storage
+    from predictionio_tpu.data.storage.base import App
+
+    aid = storage.get_metadata_apps().insert(App(0, "textapp"))
+    le = storage.get_levents()
+    le.init(aid)
+    t0 = dt.datetime(2022, 1, 1, tzinfo=UTC)
+    le.insert_batch(
+        [Event(event="$set", entity_type="doc", entity_id=f"d{i}",
+               properties={"text": d.text, "label": d.label},
+               event_time=t0) for i, d in enumerate(docs)], aid)
+    engine = engine_factory()
+    params = EngineParams(
+        data_source_params=("", DataSourceParams(app_name="textapp")),
+        preparator_params=("", prep or PreparatorParams(
+            vocab_size=1024, max_tokens=32)),
+        algorithm_params_list=[(algo_name, algo_params)])
+    persistable = engine.train(ComputeContext(), params, "tx1")
+    [model] = engine.prepare_deploy(ComputeContext(), params, "tx1",
+                                    persistable)
+    algo = engine._algorithms(params)[0]
+    return engine, params, algo, model
+
+
+def _accuracy(algo, model, docs):
+    hits = sum(
+        algo.predict(model, Query(text=d.text)).label == d.label
+        for d in docs)
+    return hits / len(docs)
+
+
+class TestEndToEnd:
+    def test_lr_trains_and_classifies(self, mem_storage):
+        docs = corpus()
+        engine, params, algo, model = _train_engine(
+            "lr", TextLRParams(embedding_dim=16, epochs=25,
+                               batch_size=64, seed=1), docs)
+        held = corpus(n_per_class=15, seed=9)
+        acc = _accuracy(algo, model, held)
+        assert acc >= 0.9, acc
+        res = algo.predict(model, Query(text="sport1 sport2 sport3"))
+        assert res.label == "sports"
+        assert abs(sum(res.scores.values()) - 1.0) < 1e-5
+
+    def test_nb_trains_and_classifies(self, mem_storage):
+        docs = corpus()
+        engine, params, algo, model = _train_engine(
+            "nb", TextNBParams(lambda_=1.0), docs)
+        held = corpus(n_per_class=15, seed=9)
+        assert _accuracy(algo, model, held) >= 0.9
+
+    def test_model_pickles_and_serves(self, mem_storage):
+        docs = corpus(n_per_class=20)
+        _, _, algo, model = _train_engine(
+            "lr", TextLRParams(embedding_dim=8, epochs=10, seed=0), docs)
+        clone = pickle.loads(pickle.dumps(model))
+        q = Query(text="tech3 tech4 tech5 tech6")
+        assert algo.predict(clone, q).label == \
+            algo.predict(model, q).label == "tech"
+
+    def test_eval_folds_and_accuracy_metric(self, mem_storage):
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import App
+
+        aid = storage.get_metadata_apps().insert(App(0, "evalapp"))
+        le = storage.get_levents()
+        le.init(aid)
+        t0 = dt.datetime(2022, 1, 1, tzinfo=UTC)
+        docs = corpus(n_per_class=20)
+        le.insert_batch(
+            [Event(event="$set", entity_type="doc", entity_id=f"d{i}",
+                   properties={"text": d.text, "label": d.label},
+                   event_time=t0) for i, d in enumerate(docs)], aid)
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(
+                app_name="evalapp", eval_k=3)),
+            preparator_params=("", PreparatorParams(vocab_size=512,
+                                                    max_tokens=16)),
+            algorithm_params_list=[("nb", TextNBParams())])
+        folds = [(info, list(qpas))
+                 for info, qpas in engine.eval(ComputeContext(), params)]
+        assert len(folds) == 3
+        assert all(qpas for _info, qpas in folds)
+        acc = Accuracy().calculate(ComputeContext(), folds)
+        assert acc >= 0.85
+
+    def test_needs_two_labels(self, mem_storage):
+        docs = [Document(text="aaa bbb", label="only")] * 5
+        with pytest.raises(AssertionError, match="distinct labels"):
+            _train_engine("nb", TextNBParams(), docs)
