@@ -35,8 +35,9 @@ trip here is pure regression and is treated as such):
 
 from __future__ import annotations
 
+import threading
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -166,6 +167,9 @@ class HostTopK:
             -> None:
         """Nothing to compile host-side."""
 
+    def close(self) -> None:
+        """Interface parity with DeviceTopK; nothing to release."""
+
     def _topk_row(self, scores: np.ndarray, k: int):
         k = min(k, scores.shape[0])
         top = np.argpartition(-scores, k - 1)[:k]
@@ -247,6 +251,117 @@ def choose_server(user_factors, item_factors,
                n_users=n_users, n_items=n_items)
 
 
+class _PendingQuery:
+    __slots__ = ("uid", "k", "done", "result", "error")
+
+    def __init__(self, uid: int, k: int):
+        self.uid = uid
+        self.k = k
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class _MicroBatcher:
+    """Cross-request micro-batching for per-user device queries
+    (round-4 verdict weak #5: concurrent single-query REST clients each
+    paid their own device dispatch serially).
+
+    Callers enqueue (uid, k) and block on a per-request event; one
+    dispatcher thread drains EVERYTHING pending into a single
+    ``users_topk`` dispatch. No artificial wait window: while a device
+    dispatch is in flight, new arrivals pile up and form the next batch
+    — at low load a query pays one dispatch exactly as before, under
+    load throughput approaches the batched-program rate instead of
+    one transport round trip per query (the live-server application of
+    ``P2LAlgorithm.scala:66-68`` batch semantics)."""
+
+    def __init__(self, server: "DeviceTopK", max_batch: int = 256):
+        import weakref
+
+        # weakref: the dispatcher thread must not pin the server's
+        # factor matrices alive after the owner drops it (model swap)
+        self._srv_ref = weakref.ref(server)
+        self._max = max_batch
+        self._cv = threading.Condition()
+        self._pending: List[_PendingQuery] = []
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.dispatches = 0      # stats: device dispatches issued
+        self.batched_queries = 0  # stats: queries served through them
+
+    def submit(self, uid: int, k: int):
+        item = _PendingQuery(uid, k)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("serving backend is closed")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="pio-microbatch")
+                self._thread.start()
+            self._pending.append(item)
+            self._cv.notify()
+        item.done.wait()
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def close(self) -> None:
+        """Stop the dispatcher thread (pending queries get an error)."""
+        with self._cv:
+            self._closed = True
+            pending, self._pending = self._pending, []
+            self._cv.notify()
+        for it in pending:
+            it.error = RuntimeError("serving backend closed")
+            it.done.set()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    # timeout wake: exit when the server was dropped
+                    self._cv.wait(timeout=1.0)
+                    if not self._pending and self._srv_ref() is None:
+                        return
+                if self._closed and not self._pending:
+                    return
+                group = self._pending[:self._max]
+                del self._pending[:self._max]
+            srv = self._srv_ref()
+            try:
+                if srv is None:
+                    raise RuntimeError("serving backend was released")
+                kmax = max(it.k for it in group)
+                n = len(group)
+                uids = np.asarray([it.uid for it in group],
+                                  dtype=np.int64)
+                if n > 8:
+                    # pad to the ONE large uid bucket so live traffic
+                    # only ever needs the two batch programs warmup
+                    # compiled (8 and max_batch) — hard part #4: no
+                    # query may pay a serve-time XLA compile
+                    padded = np.zeros(self._max, dtype=np.int64)
+                    padded[:n] = uids
+                    idx, scores = srv.users_topk(padded, kmax)
+                else:
+                    idx, scores = srv.users_topk(uids, kmax)
+                self.dispatches += 1
+                self.batched_queries += n
+                for row, it in enumerate(group):
+                    ri = idx[row, :it.k]
+                    rs = scores[row, :it.k]
+                    valid = np.isfinite(rs)
+                    it.result = (ri[valid], rs[valid])
+            except BaseException as e:  # propagate to every waiter
+                for it in group:
+                    it.error = e
+            finally:
+                del srv  # never hold the server across the cv wait
+                for it in group:
+                    it.done.set()
+
+
 class DeviceTopK:
     """AOT-compiled top-N server over device-resident (optionally
     sharded) factor matrices.
@@ -254,6 +369,10 @@ class DeviceTopK:
     ``user_factors``/``item_factors`` may be host numpy (placed on the
     default device) or jax Arrays that are already sharded — they are
     used as-is, so a PAlgorithm model's HBM shards serve directly.
+
+    Concurrent ``user_topk`` callers are micro-batched into one device
+    dispatch (see :class:`_MicroBatcher`); set ``microbatch=False`` or
+    ``PIO_SERVING_MICROBATCH=0`` to dispatch per call.
     """
 
     ITEM_QUERY_BUCKET = 8  # padded query-item count for similarity queries
@@ -261,8 +380,17 @@ class DeviceTopK:
     def __init__(self, user_factors, item_factors,
                  seen: Optional[Dict[int, np.ndarray]] = None,
                  n_users: Optional[int] = None,
-                 n_items: Optional[int] = None):
+                 n_items: Optional[int] = None,
+                 microbatch: Optional[bool] = None):
+        import os
+
         import jax.numpy as jnp
+
+        if microbatch is None:
+            microbatch = os.environ.get(
+                "PIO_SERVING_MICROBATCH",
+                "1").strip().lower() not in ("0", "off", "false")
+        self._batcher = _MicroBatcher(self) if microbatch else None
 
         self._X = (user_factors if hasattr(user_factors, "sharding")
                    else jnp.asarray(user_factors))
@@ -338,7 +466,13 @@ class DeviceTopK:
         """Compile + run EVERY bucket program up to ``max_k`` (deploy-time
         AOT so no live query in that range ever pays a compile — SURVEY
         hard part #4). ``batch_sizes`` additionally warms the batched
-        multi-query programs at those uid-bucket sizes."""
+        multi-query programs at those uid-bucket sizes; with
+        micro-batching on, the two uid buckets the batcher dispatches at
+        (8 and its max batch) are always included."""
+        batch_sizes = tuple(batch_sizes)
+        if self._batcher is not None:
+            extra = {8, self._batcher._max} - set(batch_sizes)
+            batch_sizes += tuple(sorted(extra))
         k = 16
         while True:
             self.user_topk(0, min(k, self.n_items))
@@ -350,14 +484,28 @@ class DeviceTopK:
             k *= 2
         self.items_topk([0], min(16, self.n_items))
 
+    def close(self) -> None:
+        """Release the micro-batch dispatcher (idempotent). Dropping the
+        last reference also stops it within its wait timeout."""
+        if self._batcher is not None:
+            self._batcher.close()
+
     # -- serving ----------------------------------------------------------
 
     def user_topk(self, uid: int, k: int) -> Tuple[np.ndarray, np.ndarray]:
-        """(item indices, scores) for one user, descending; seen items are
-        masked on device. k is rounded up to the compiled bucket and the
-        result clipped, so arbitrary nums reuse programs. Costs exactly
-        one blocking device→host round trip (the packed fetch); the uid
-        rides inside the async jit dispatch."""
+        """(item indices, scores) for one user, descending; seen items
+        are masked on device. With micro-batching on (the default),
+        concurrent callers share ONE device dispatch; a lone caller
+        still pays exactly one blocking round trip."""
+        if self._batcher is not None:
+            return self._batcher.submit(int(uid), int(k))
+        return self._user_topk_direct(uid, k)
+
+    def _user_topk_direct(self, uid: int,
+                          k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """The unbatched per-call program: k rounds up to the compiled
+        bucket and the result is clipped, so arbitrary nums reuse
+        programs; the uid rides inside the async jit dispatch."""
         kb = min(_bucket(k), self.n_items)
         out = self._user_program(kb)(
             self._X, self._Y, self._seen_cols, self._seen_mask,

@@ -78,12 +78,18 @@ class TestDeviceTopK:
     def test_bucket_reuse(self, factors):
         X, Y, seen = factors
         srv = DeviceTopK(X, Y, seen)
+        # micro-batched path: all single queries ride the batched
+        # program at the same (k-bucket, uid-bucket)
         srv.user_topk(0, 3)
         srv.user_topk(1, 9)     # same 16-bucket
         srv.user_topk(2, 16)
-        assert len(srv._user_programs) == 1
+        assert len(srv._batch_programs) == 1
         srv.user_topk(0, 17)    # 32-bucket -> clipped to n_items=33
-        assert len(srv._user_programs) == 2
+        assert len(srv._batch_programs) == 2
+        # the direct (unbatched) program path buckets identically
+        srv._user_topk_direct(0, 3)
+        srv._user_topk_direct(1, 9)
+        assert len(srv._user_programs) == 1
 
     def test_sharded_factors_serve_without_host_gather(self):
         """Factors sharded over an 8-device mesh serve directly."""
@@ -148,6 +154,153 @@ class TestDeviceTopK:
         assert set(cols[0][mask[0] > 0].tolist()) == {3, 1}
         assert mask[1].sum() == 0
         assert cols[2][0] == 7 and mask[2].sum() == 1
+
+
+class TestMicroBatching:
+    """Concurrent single-query callers share device dispatches
+    (round-4 verdict weak #5); per-query results stay exact."""
+
+    @pytest.fixture(scope="class")
+    def factors(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(20, 6)).astype(np.float32)
+        Y = rng.normal(size=(33, 6)).astype(np.float32)
+        seen = {u: rng.choice(33, size=rng.integers(1, 6), replace=False)
+                for u in range(0, 20, 2)}
+        return X, Y, seen
+
+    def test_concurrent_queries_correct_and_grouped(self, factors):
+        import threading
+        import time
+
+        X, Y, seen = factors
+        srv = DeviceTopK(X, Y, seen)
+        # slow the batched program so in-flight time accumulates real
+        # groups (on CPU a dispatch is too fast to overlap otherwise)
+        orig = srv.users_topk
+
+        def slow_users_topk(uids, k):
+            time.sleep(0.02)
+            return orig(uids, k)
+
+        srv.users_topk = slow_users_topk
+        results = {}
+        errors = []
+
+        def worker(tx):
+            try:
+                for i in range(6):
+                    uid = (tx * 6 + i) % X.shape[0]
+                    k = 3 + (i % 3)
+                    results[(tx, i)] = (uid, k, srv.user_topk(uid, k))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errors
+        total = 8 * 6
+        assert len(results) == total
+        # grouping happened: far fewer dispatches than queries, and
+        # wall-clock far under the serial 48 x 20ms
+        assert srv._batcher.dispatches < total * 0.75
+        assert srv._batcher.batched_queries == total
+        assert wall < total * 0.02 * 0.75
+        for (tx, i), (uid, k, (idx, scores)) in results.items():
+            want_idx, want_scores = host_oracle_topk(X, Y, seen, uid, k)
+            assert idx.tolist() == want_idx.tolist(), (uid, k)
+            np.testing.assert_allclose(scores, want_scores, rtol=1e-5)
+
+    def test_mixed_k_in_one_group(self, factors):
+        X, Y, seen = factors
+        srv = DeviceTopK(X, Y, seen)
+        # force one group by stuffing the queue before the thread starts
+        b = srv._batcher
+        from predictionio_tpu.ops.serving import _PendingQuery
+
+        items = [_PendingQuery(u, k) for u, k in
+                 [(0, 2), (1, 7), (2, 4), (3, 1)]]
+        with b._cv:
+            b._pending.extend(items)
+        b.submit(4, 5)  # starts the dispatcher, joins the same queue
+        for it in items:
+            assert it.done.wait(timeout=10)
+            assert it.error is None
+            idx, scores = it.result
+            want_idx, _ = host_oracle_topk(X, Y, seen, it.uid, it.k)
+            assert idx.tolist() == want_idx.tolist()
+
+    def test_error_propagates_to_all_waiters(self, factors):
+        X, Y, seen = factors
+        srv = DeviceTopK(X, Y, seen)
+
+        def boom(uids, k):
+            raise RuntimeError("device fell over")
+
+        srv.users_topk = boom
+        with pytest.raises(RuntimeError, match="fell over"):
+            srv.user_topk(0, 3)
+
+    def test_disable_flag(self, factors, monkeypatch):
+        X, Y, seen = factors
+        monkeypatch.setenv("PIO_SERVING_MICROBATCH", "OFF")  # any case
+        srv = DeviceTopK(X, Y, seen)
+        assert srv._batcher is None
+        idx, _ = srv.user_topk(1, 4)
+        want_idx, _ = host_oracle_topk(X, Y, seen, 1, 4)
+        assert idx.tolist() == want_idx.tolist()
+
+    def test_large_group_uses_warmed_bucket(self, factors):
+        """A group larger than 8 pads to the batcher's max bucket so
+        live traffic only ever hits the two warmed batch programs."""
+        X, Y, seen = factors
+        srv = DeviceTopK(X, Y, seen)
+        srv.warmup(max_k=16)
+        compiled = set(srv._batch_programs)
+        b = srv._batcher
+        from predictionio_tpu.ops.serving import _PendingQuery
+
+        items = [_PendingQuery(u % X.shape[0], 3) for u in range(20)]
+        with b._cv:
+            b._pending.extend(items)
+        b.submit(0, 3)
+        for it in items:
+            assert it.done.wait(timeout=10) and it.error is None
+        # no NEW batch program was compiled by the 21-query group
+        assert set(srv._batch_programs) == compiled
+
+    def test_close_stops_dispatcher_and_gc_releases(self, factors):
+        import gc
+        import threading
+        import time
+        import weakref
+
+        X, Y, seen = factors
+        srv = DeviceTopK(X, Y, seen)
+        srv.user_topk(0, 3)  # starts the dispatcher
+        assert any(t.name == "pio-microbatch" for t in
+                   threading.enumerate())
+        srv.close()
+        time.sleep(0.1)
+        with pytest.raises(RuntimeError, match="closed"):
+            srv.user_topk(0, 3)
+        # GC path: a dropped server's dispatcher exits on its own
+        srv2 = DeviceTopK(X, Y, seen)
+        srv2.user_topk(0, 3)
+        ref = weakref.ref(srv2)
+        del srv2
+        gc.collect()
+        for _ in range(30):
+            if ref() is None:
+                break
+            time.sleep(0.1)
+        assert ref() is None  # the thread does not pin the factors
 
 
 class TestHostTopK:
