@@ -398,6 +398,18 @@ class SqliteLEvents(base.LEvents):
                 " target_entity_id, properties, event_time, tags, pr_id,"
                 " creation_time) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", full)
 
+    def iter_raw_rows(self, app_id: int,
+                      channel_id: Optional[int] = None):
+        """Data-plane raw read (inverse of ``insert_raw_batch``, same
+        tuple shape): the columnar exporter streams rows without ever
+        building Event objects."""
+        yield from self._client.query_iter(
+            "SELECT event_id, event, entity_type, entity_id,"
+            " target_entity_type, target_entity_id, properties,"
+            " event_time, tags, pr_id, creation_time FROM events"
+            " WHERE app_id=? AND channel_id=? ORDER BY event_time, rowid",
+            (int(app_id), self._chan(channel_id)))
+
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
         row = self._client.query_one(
             f"SELECT {_EVENT_COLS} FROM events WHERE app_id=? AND channel_id=?"

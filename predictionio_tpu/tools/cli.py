@@ -229,14 +229,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     from predictionio_tpu.tools import export_import
 
-    exp = sub.add_parser("export", help="export events to a JSON-lines file")
+    exp = sub.add_parser(
+        "export", help="export events to a JSON-lines or columnar file")
     exp.add_argument("--output", required=True)
     exp.add_argument("--app-name", default=None)
     exp.add_argument("--appid", type=int, default=None)
     exp.add_argument("--channel", default=None)
+    exp.add_argument(
+        "--format", choices=("jsonl", "columnar"), default="jsonl",
+        help="jsonl (wire-format interchange, default) or columnar "
+             "(dictionary-encoded npz — the Parquet analog, "
+             "EventsToFile.scala:35,94; import sniffs the format)")
     exp.set_defaults(func=export_import.dispatch_export)
 
-    imp = sub.add_parser("import", help="import events from a JSON-lines file")
+    imp = sub.add_parser(
+        "import", help="import events from a JSON-lines or columnar file")
     imp.add_argument("--input", required=True)
     imp.add_argument("--app-name", default=None)
     imp.add_argument("--appid", type=int, default=None)

@@ -168,6 +168,215 @@ class TestExportImport:
         assert main(["import", "--app-name", "impbad", "--input",
                      str(bad)]) == 1
 
+    def test_columnar_roundtrip_full_fidelity(self, mem_storage, tmp_path,
+                                              capsys):
+        """The Parquet-analog format: every field survives a columnar
+        round trip, including tags/prId/no-target events and None
+        properties, and import auto-detects the format."""
+        import datetime as dt
+
+        from predictionio_tpu.data.event import Event
+
+        main(["app", "new", "colapp"])
+        app = storage.get_metadata_apps().get_by_name("colapp")
+        le = storage.get_levents()
+        t0 = dt.datetime(2021, 5, 1, tzinfo=dt.timezone.utc)
+        evs = [
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties={"rating": 4.5, "note": "great"},
+                  tags=("a", "b"), pr_id="pr9", event_time=t0),
+            Event(event="$set", entity_type="user", entity_id="u2",
+                  properties={"vip": True},
+                  event_time=t0 + dt.timedelta(seconds=1)),
+            Event(event="view", entity_type="user", entity_id="u3",
+                  target_entity_type="item", target_entity_id="i2",
+                  event_time=t0 + dt.timedelta(seconds=2)),
+        ]
+        ids = le.insert_batch(evs, app.id)
+        out = str(tmp_path / "events.npz")
+        assert main(["export", "--app-name", "colapp", "--output", out,
+                     "--format", "columnar"]) == 0
+        from predictionio_tpu.tools.export_import import is_columnar_export
+        assert is_columnar_export(out)
+
+        main(["app", "new", "colimp"])
+        assert main(["import", "--app-name", "colimp", "--input",
+                     out]) == 0
+        app2 = storage.get_metadata_apps().get_by_name("colimp")
+        got = {e.entity_id: e for e in le.find(app2.id)}
+        assert set(got) == {"u1", "u2", "u3"}
+        e1 = got["u1"]
+        assert e1.event_id == ids[0]  # ids preserved
+        assert e1.properties.fields == {"rating": 4.5, "note": "great"}
+        assert e1.tags == ("a", "b") and e1.pr_id == "pr9"
+        assert e1.event_time == t0
+        assert got["u2"].target_entity_type is None
+        assert got["u2"].properties.fields == {"vip": True}
+        assert got["u3"].properties.fields == {}
+
+    def test_columnar_roundtrip_sqlite_raw_lane(self, sqlite_storage,
+                                                tmp_path, capsys):
+        import datetime as dt
+
+        from predictionio_tpu.data.event import Event
+
+        main(["app", "new", "colsql"])
+        app = storage.get_metadata_apps().get_by_name("colsql")
+        le = storage.get_levents()
+        t0 = dt.datetime(2021, 5, 1, tzinfo=dt.timezone.utc)
+        le.insert_batch(
+            [Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                   target_entity_type="item", target_entity_id=f"i{i % 3}",
+                   properties={"rating": float(i % 5)},
+                   event_time=t0 + dt.timedelta(seconds=i))
+             for i in range(50)], app.id)
+        out = str(tmp_path / "events.npz")
+        assert main(["export", "--app-name", "colsql", "--output", out,
+                     "--format", "columnar"]) == 0
+        main(["app", "new", "colsql2"])
+        assert main(["import", "--app-name", "colsql2", "--input",
+                     out]) == 0
+        app2 = storage.get_metadata_apps().get_by_name("colsql2")
+        got = list(le.find(app2.id))
+        assert len(got) == 50
+        assert {e.entity_id for e in got} == {f"u{i}" for i in range(50)}
+        assert all(e.properties.get("rating") is not None for e in got)
+
+    def test_columnar_import_validates(self, mem_storage, tmp_path,
+                                       capsys):
+        """A hand-built container must not bypass event validation."""
+        import numpy as np
+
+        from predictionio_tpu.tools import export_import as ei
+
+        arrays = {
+            "format_version": np.int64(ei.COLUMNAR_FORMAT_VERSION),
+            "n_events": np.int64(1),
+            "event_ids": np.asarray(["x"], dtype=np.str_),
+            "event_times": np.asarray([0.0]),
+            "creation_times": np.asarray([np.nan]),
+            "properties": np.asarray([""], dtype=np.str_),
+            "tags": np.asarray([""], dtype=np.str_),
+        }
+        cols = {"events": ["$bogus"], "entity_types": ["user"],
+                "entity_ids": ["u1"], "target_entity_types": [None],
+                "target_entity_ids": [None], "pr_ids": [None]}
+        for name, vals in cols.items():
+            codes, labels = ei._dict_encode(vals)
+            arrays[f"{name}_codes"] = codes
+            arrays[f"{name}_labels"] = labels
+        bad = tmp_path / "bad.npz"
+        with open(bad, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        main(["app", "new", "colbad"])
+        assert main(["import", "--app-name", "colbad", "--input",
+                     str(bad)]) == 1
+        err = capsys.readouterr().err
+        assert "not a supported reserved event name" in err
+
+    def test_columnar_import_rejects_bad_props_json(self, mem_storage,
+                                                    tmp_path, capsys):
+        """The raw lane writes property strings verbatim; malformed JSON
+        must be rejected up front, not poison later reads."""
+        import numpy as np
+
+        from predictionio_tpu.tools import export_import as ei
+
+        arrays = {
+            "format_version": np.int64(ei.COLUMNAR_FORMAT_VERSION),
+            "n_events": np.int64(1),
+            "event_ids": np.asarray(["x"], dtype=np.str_),
+            "event_times": np.asarray([1.0]),
+            "creation_times": np.asarray([np.nan]),
+            "properties": np.asarray(["{not json"], dtype=np.str_),
+            "tags": np.asarray([""], dtype=np.str_),
+        }
+        cols = {"events": ["rate"], "entity_types": ["user"],
+                "entity_ids": ["u1"], "target_entity_types": [None],
+                "target_entity_ids": [None], "pr_ids": [None]}
+        for name, vals in cols.items():
+            codes, labels = ei._dict_encode(vals)
+            arrays[f"{name}_codes"] = codes
+            arrays[f"{name}_labels"] = labels
+        bad = tmp_path / "badprops.npz"
+        with open(bad, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        main(["app", "new", "colbadp"])
+        assert main(["import", "--app-name", "colbadp", "--input",
+                     str(bad)]) == 1
+        assert "bad properties JSON" in capsys.readouterr().err
+
+    def test_import_zip_but_not_npz_errors_cleanly(self, mem_storage,
+                                                   tmp_path, capsys):
+        import zipfile
+
+        z = tmp_path / "events.zip"
+        with zipfile.ZipFile(z, "w") as zf:
+            zf.writestr("events.jsonl", '{"event":"rate"}\n')
+        main(["app", "new", "zipapp"])
+        assert main(["import", "--app-name", "zipapp", "--input",
+                     str(z)]) == 1
+        assert "not a readable columnar" in capsys.readouterr().err
+
+    def test_columnar_roundtrip_faster_and_smaller_at_scale(
+            self, sqlite_storage, tmp_path, capsys):
+        """The point of the format (EventsToFile.scala:35,94 parquet
+        default): at 100k events the columnar round trip beats jsonl on
+        wall-clock and the file is an order of magnitude smaller
+        (measured at 1M: 30.4s vs 48.8s, 7MB vs 243MB)."""
+        import time
+
+        import numpy as np
+
+        main(["app", "new", "bigexp"])
+        app = storage.get_metadata_apps().get_by_name("bigexp")
+        le = storage.get_levents()
+        rng = np.random.default_rng(0)
+        N = 100_000
+        rows = [(f"id{i:06d}", "rate", "user",
+                 f"u{rng.integers(0, 2000)}", "item",
+                 f"i{rng.integers(0, 500)}",
+                 '{"rating":%d}' % rng.integers(1, 6),
+                 1600000000.0 + i, "[]", None, 1600000000.0)
+                for i in range(N)]
+        le.init(app.id)
+        le.insert_raw_batch(rows, app.id, None)
+
+        jl, npz = str(tmp_path / "e.jsonl"), str(tmp_path / "e.npz")
+        t0 = time.perf_counter()
+        assert main(["export", "--app-name", "bigexp", "--output",
+                     jl]) == 0
+        main(["app", "new", "impj"])
+        assert main(["import", "--app-name", "impj", "--input", jl]) == 0
+        t_jsonl = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        assert main(["export", "--app-name", "bigexp", "--output", npz,
+                     "--format", "columnar"]) == 0
+        main(["app", "new", "impc"])
+        assert main(["import", "--app-name", "impc", "--input",
+                     npz]) == 0
+        t_col = time.perf_counter() - t0
+
+        import os as _os
+        assert _os.path.getsize(npz) < _os.path.getsize(jl) / 10
+        # generous CI-noise margin; the format must never be
+        # catastrophically slower (measured 1.6x faster at 1M)
+        assert t_col < t_jsonl * 1.5, (t_col, t_jsonl)
+        aj = storage.get_metadata_apps().get_by_name("impj")
+        ac = storage.get_metadata_apps().get_by_name("impc")
+        nj = sum(1 for _ in le.find(aj.id, limit=-1))
+        nc = sum(1 for _ in le.find(ac.id, limit=-1))
+        assert nj == nc == N
+
+    def test_bad_format_flag(self, mem_storage, tmp_path, capsys):
+        main(["app", "new", "fmtapp"])
+        import pytest as _pytest
+        with _pytest.raises(SystemExit):
+            main(["export", "--app-name", "fmtapp", "--output",
+                  str(tmp_path / "x"), "--format", "parquet"])
+
 
 class TestTemplateAndLifecycleVerbs:
     def seed(self, app_name="cliapp", n_users=12):
