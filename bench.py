@@ -426,7 +426,51 @@ def serving_bench(X: np.ndarray, Y: np.ndarray, n_queries: int = 300,
         hsrv.user_topk(int(uid), 10)
         host.append((time.perf_counter() - t0) * 1e3)
 
+    # concurrent single-query clients (the REST shape): the server-side
+    # micro-batcher merges in-flight requests into shared dispatches,
+    # so aggregate throughput rises far above 1/RTT even though every
+    # caller issues lone user_topk calls (round-4 verdict weak #5)
+    import threading
+
+    # (batcher buckets were already warmed by the warmup() at creation)
+    CONC_THREADS, PER_THREAD = 16, 25
+    conc_total = CONC_THREADS * PER_THREAD
+    client_errors: list = []
+    b = srv._batcher
+    # deltas, not cumulative counters: the sequential sections above
+    # also ran through the batcher (one dispatch per lone query)
+    d0 = (b.dispatches, b.batched_queries) if b is not None else (0, 0)
+
+    def client(tx):
+        try:
+            for i in range(PER_THREAD):
+                srv.user_topk(
+                    int(uids[(tx * PER_THREAD + i) % len(uids)]), 10)
+        except Exception as e:  # a partial run must not look like slow
+            client_errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(CONC_THREADS)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    conc_sec = time.perf_counter() - t0
+    if client_errors:
+        raise client_errors[0]
+    dispatches = None if b is None else b.dispatches - d0[0]
+    grouped = None if b is None else b.batched_queries - d0[1]
+
     return {
+        "concurrent_single_query": {
+            "threads": CONC_THREADS,
+            "queries": conc_total,
+            "queries_per_sec": round(conc_total / conc_sec, 1),
+            "device_dispatches": dispatches,
+            "mean_group_size": None if not dispatches
+            else round(grouped / dispatches, 1),
+        },
         "single_query": pcts(single),
         "transport_rtt_ms": round(float(np.median(rtt)), 3),
         "device_exec_us": round(exec_us, 1),
