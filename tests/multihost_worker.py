@@ -12,21 +12,29 @@ import json
 import sys
 
 
-def make_problem():
-    """The shared tiny ALS problem — ONE definition for the workers and
+N_USERS, N_ITEMS = 16, 12
+
+
+def raw_triples():
+    """The shared tiny rating triples — ONE definition for workers and
     the parent test's single-process reference, so they can't drift."""
     import numpy as np
 
+    rng = np.random.default_rng(0)
+    nnz = 96
+    rows = rng.integers(0, N_USERS, nnz)
+    cols = rng.integers(0, N_ITEMS, nnz)
+    vals = rng.random(nnz).astype(np.float32) + 0.5
+    return rows, cols, vals
+
+
+def make_problem():
     from predictionio_tpu.ops.als import ALSParams, pad_ratings
 
-    rng = np.random.default_rng(0)
-    n_users, n_items, rank, nnz = 16, 12, 4, 96
-    rows = rng.integers(0, n_users, nnz)
-    cols = rng.integers(0, n_items, nnz)
-    vals = rng.random(nnz).astype(np.float32) + 0.5
-    user_side = pad_ratings(rows, cols, vals, n_users, n_items)
-    item_side = pad_ratings(cols, rows, vals, n_items, n_users)
-    return user_side, item_side, ALSParams(rank=rank, num_iterations=3,
+    rows, cols, vals = raw_triples()
+    user_side = pad_ratings(rows, cols, vals, N_USERS, N_ITEMS)
+    item_side = pad_ratings(cols, rows, vals, N_ITEMS, N_USERS)
+    return user_side, item_side, ALSParams(rank=4, num_iterations=3,
                                            seed=0)
 
 
@@ -49,12 +57,29 @@ def main() -> None:
 
     mesh = distributed.host_aware_mesh()
     X, Y = train_als_sharded(user_side, item_side, params, mesh)
+
+    # the bucketed layout over the same global mesh (each host
+    # contributes its row block of every bucket table) must land on the
+    # same factors
+    from predictionio_tpu.ops.als import bucket_ratings_pair
+    from predictionio_tpu.parallel.als_sharding import (
+        train_als_bucketed_sharded,
+    )
+
+    rows, cols, vals = raw_triples()
+    ub, ib = bucket_ratings_pair(rows, cols, vals, user_side.n_rows,
+                                 item_side.n_rows)
+    Xb, Yb = train_als_bucketed_sharded(ub, ib, params, mesh)
+
     print(json.dumps({
         "process_id": process_id,
         "devices": len(mesh.devices.ravel()),
         "x_sum": float(np.abs(X).sum()),
         "y_sum": float(np.abs(Y).sum()),
         "x_row0": [float(v) for v in X[0]],
+        "bucketed_x_sum": float(np.abs(Xb).sum()),
+        "bucketed_max_dx": float(np.abs(Xb - X).max()),
+        "bucketed_max_dy": float(np.abs(Yb - Y).max()),
     }), flush=True)
     distributed.shutdown()
 

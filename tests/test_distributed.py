@@ -103,6 +103,11 @@ def test_two_process_training_matches_single(tmp_path):
     assert all(o["devices"] == 4 for o in outs)
     # both hosts computed (and allgathered) identical factors
     assert outs[0]["x_sum"] == pytest.approx(outs[1]["x_sum"], rel=1e-6)
+    # the bucketed layout trained over the same 2-host mesh agrees with
+    # the uniform result on every factor entry
+    for o in outs:
+        assert o["bucketed_max_dx"] < 1e-4, o
+        assert o["bucketed_max_dy"] < 1e-4, o
 
     # reference: the same problem single-process on the local mesh
     from predictionio_tpu.ops.als import train_als
