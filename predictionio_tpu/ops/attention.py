@@ -119,11 +119,10 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
     return (o / denom[..., None]).astype(q.dtype)
 
 
-@functools.lru_cache(maxsize=64)
-def _ring_fn(mesh, axis_name: str, causal: bool, scale: float):
-    """Cached jitted shard_map program per (mesh, axis, causal, scale) —
-    repeated calls (e.g. one per layer per step) hit the jit cache
-    instead of retracing (same pattern as parallel/als_sharding.py)."""
+def _sp_program(local_body, mesh, axis_name: str):
+    """shard_map + jit a per-device attention body with q/k/v/out all
+    sequence-sharded over ``axis_name`` — the shared scaffolding of both
+    SP schemes."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -131,15 +130,40 @@ def _ring_fn(mesh, axis_name: str, causal: bool, scale: float):
     if shard_map is None:  # older jax
         from jax.experimental.shard_map import shard_map
 
-    n = mesh.shape[axis_name]
     fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name,
-                          axis_size=n, causal=causal, scale=scale),
+        local_body,
         mesh=mesh,
         in_specs=(P(None, None, axis_name, None),) * 3,
         out_specs=P(None, None, axis_name, None),
     )
     return jax.jit(fn)
+
+
+def _sp_call(program, q, k, v, mesh, axis_name: str):
+    """Stage the global arrays sequence-sharded and run the program."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n:
+        raise ValueError(
+            f"sequence length {q.shape[2]} not divisible by mesh axis "
+            f"{axis_name} of size {n}")
+    spec = NamedSharding(mesh, P(None, None, axis_name, None))
+    q, k, v = (jax.device_put(x, spec) for x in (q, k, v))
+    return program(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_fn(mesh, axis_name: str, causal: bool, scale: float):
+    """Cached jitted shard_map program per (mesh, axis, causal, scale) —
+    repeated calls (e.g. one per layer per step) hit the jit cache
+    instead of retracing (same pattern as parallel/als_sharding.py)."""
+    return _sp_program(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          axis_size=mesh.shape[axis_name], causal=causal,
+                          scale=scale),
+        mesh, axis_name)
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = "data",
@@ -151,16 +175,64 @@ def ring_attention(q, k, v, mesh, axis_name: str = "data",
     K/V blocks rotate around the ring (ICI ppermute). Returns the global
     ``[B, H, L, D]`` result matching :func:`mha_reference`.
     """
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    n = mesh.shape[axis_name]
-    if q.shape[2] % n:
-        raise ValueError(
-            f"sequence length {q.shape[2]} not divisible by mesh axis "
-            f"{axis_name} of size {n}")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _sp_call(_ring_fn(mesh, axis_name, causal, float(scale)),
+                    q, k, v, mesh, axis_name)
 
-    spec = NamedSharding(mesh, P(None, None, axis_name, None))
-    q, k, v = (jax.device_put(x, spec) for x in (q, k, v))
-    return _ring_fn(mesh, axis_name, causal, float(scale))(q, k, v)
+
+# ---------------------------------------------------------------------------
+# Ulysses-style all-to-all sequence parallelism
+# ---------------------------------------------------------------------------
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+    """Per-device body: all_to_all swaps the sequence shard for a HEAD
+    shard, so each device runs DENSE attention for its head group over
+    the FULL sequence (causal masking is then trivially exact), and a
+    second all_to_all restores sequence sharding.
+
+    Versus the ring: two all_to_all collectives total instead of P-1
+    ppermute steps, and the math between them is plain unsharded
+    attention — the better fit when heads divide the mesh axis and the
+    full [L, L] per-head-group score block fits HBM; the ring wins on
+    memory for extreme L (its online softmax never materializes
+    [L, L])."""
+    import jax
+
+    def swap(x, fwd: bool):
+        # [B, H, L/P, D] -> [B, H/P, L, D] (fwd) and back (not fwd)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1 if fwd else 2,
+            concat_axis=2 if fwd else 1, tiled=True)
+
+    qh, kh, vh = swap(q, True), swap(k, True), swap(v, True)
+    out = mha_reference(qh, kh, vh, causal=causal, scale=scale)
+    return swap(out, False)
+
+
+@functools.lru_cache(maxsize=64)
+def _ulysses_fn(mesh, axis_name: str, causal: bool, scale: float):
+    return _sp_program(
+        functools.partial(_ulysses_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh, axis_name)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name: str = "data",
+                      causal: bool = False,
+                      scale: Optional[float] = None):
+    """All-to-all sequence-parallel attention over ``mesh[axis_name]``
+    (DeepSpeed-Ulysses layout; see PAPERS.md): inputs/outputs are
+    sequence-sharded ``[B, H, L, D]`` exactly like
+    :func:`ring_attention`, but internally each device attends its
+    H/P-head group over the full sequence between two all_to_all
+    collectives. Requires both ``L`` and ``H`` divisible by the axis
+    size. Numerics match :func:`mha_reference`."""
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"head count {q.shape[1]} not divisible by mesh axis "
+            f"{axis_name} of size {n} — use ring_attention for "
+            "head counts below the mesh size")
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _sp_call(_ulysses_fn(mesh, axis_name, causal, float(scale)),
+                    q, k, v, mesh, axis_name)

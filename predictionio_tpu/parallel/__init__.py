@@ -17,8 +17,11 @@ from predictionio_tpu.parallel.distributed import (
     DistributedConfig,
     host_aware_mesh,
 )
-from predictionio_tpu.ops.attention import ring_attention  # sequence parallel
+from predictionio_tpu.ops.attention import (  # sequence parallel
+    ring_attention,
+    ulysses_attention,
+)
 
 __all__ = ["data_parallel_mesh", "mesh_2d", "train_als_sharded",
-           "train_als_sharded_2d", "ring_attention", "distributed",
-           "DistributedConfig", "host_aware_mesh"]
+           "train_als_sharded_2d", "ring_attention", "ulysses_attention",
+           "distributed", "DistributedConfig", "host_aware_mesh"]
