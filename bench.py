@@ -489,13 +489,29 @@ def serving_bench(X: np.ndarray, Y: np.ndarray, n_queries: int = 300,
     }
 
 
-def main() -> None:
+def main(smoke: bool = False) -> None:
+    """Full bench, or ``--smoke``: the SAME end-to-end flow at toy
+    shapes (runs in ~4 min on CPU) — an integration check that every
+    section executes and both output lines parse, so bench-day never
+    discovers a wiring error on the real device."""
+    import os
+
+    if smoke and os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # a sitecustomize (axon tunnel) may pin the real accelerator
+        # after env setup; the smoke run honors the caller's cpu ask
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     from predictionio_tpu.ops.als import ALSParams
 
-    params = ALSParams(rank=RANK, num_iterations=ITERATIONS, lambda_=LAMBDA,
+    iters = 2 if smoke else ITERATIONS
+    n_users, n_items, nnz = (300, 200, 6000) if smoke \
+        else (N_USERS, N_ITEMS, NNZ)
+    params = ALSParams(rank=RANK, num_iterations=iters, lambda_=LAMBDA,
                        alpha=ALPHA, seed=1)
 
-    user_np, item_np, processed = make_sides(N_USERS, N_ITEMS, NNZ, 7)
+    user_np, item_np, processed = make_sides(n_users, n_items, nnz, 7)
     # rating tables live in HBM for the whole training job (transferred
     # once at ingest) — so epochs measure compute; the numpy originals
     # feed the CPU baseline
@@ -503,7 +519,7 @@ def main() -> None:
 
     device_total, (X, Y) = timed_training(user_side, item_side, params)
     assert np.isfinite(X).all() and np.isfinite(Y).all()
-    device_epoch = device_total / ITERATIONS
+    device_epoch = device_total / iters
     events_per_sec = processed / device_epoch
 
     # CPU baseline: 2 epochs, take the best (steady-state)
@@ -518,8 +534,10 @@ def main() -> None:
         train_als_bucketed,
     )
 
-    r1, c1, v1 = synthetic_ratings(6040, 3706, 1_000_000, 11)
-    us1, is1 = bucket_ratings_pair(r1, c1, v1, 6040, 3706)
+    su, si, snnz = (600, 300, 50_000) if smoke \
+        else (6040, 3706, 1_000_000)
+    r1, c1, v1 = synthetic_ratings(su, si, snnz, 11)
+    us1, is1 = bucket_ratings_pair(r1, c1, v1, su, si)
     processed1 = us1.nnz
     us1, is1 = us1.to_device(), is1.to_device()
     train_als_bucketed(us1, is1, params)  # warm-compile
@@ -528,23 +546,32 @@ def main() -> None:
         t0 = time.perf_counter()
         train_als_bucketed(us1, is1, params)
         scale_total = min(scale_total, time.perf_counter() - t0)
-    scale_epoch = scale_total / ITERATIONS
+    scale_epoch = scale_total / iters
 
     # the full BASELINE shape: 20M events streamed from a partitioned
     # store, bucketed 100%-coverage device training (ingest vs epoch
     # reported separately)
-    scale20 = scale_ingest_bench()
+    scale20 = scale_ingest_bench(
+        **({"n_users": 2000, "n_items": 500, "nnz": 100_000}
+           if smoke else {}))
 
     # quality parity (the second BASELINE target): Precision@10 of the
     # device ALS vs the CPU reference on the same holdout split, plus
     # the truncation-cost check at the ML-1M shape
     import bench_quality
-    quality = bench_quality.run()
-    quality_scale = bench_quality.run_truncation_check()
+    quality = bench_quality.run(
+        **({"n_users": 600, "n_items": 300, "nnz": 40_000}
+           if smoke else {}))
+    quality_scale = bench_quality.run_truncation_check(
+        **({"n_users": 600, "n_items": 300, "nnz": 40_000,
+            "trunc_max_len": 32} if smoke else {}))
 
-    text_quality = text_classification_bench()
+    text_quality = text_classification_bench(
+        n_per_class=100 if smoke else 400)
 
-    serving = serving_bench(np.asarray(X), np.asarray(Y))
+    serving = serving_bench(np.asarray(X), np.asarray(Y),
+                            **({"n_queries": 50, "batch": 32}
+                               if smoke else {}))
 
     import jax
 
@@ -560,8 +587,8 @@ def main() -> None:
             "device": str(jax.devices()[0]).strip(),
             "epoch_sec": round(device_epoch, 4),
             "cpu_epoch_sec": round(cpu_epoch, 4),
-            "rank": RANK, "iterations": ITERATIONS,
-            "n_users": N_USERS, "n_items": N_ITEMS,
+            "rank": RANK, "iterations": iters,
+            "n_users": n_users, "n_items": n_items,
             "events_processed": processed,
             "scale_1m": {
                 "epoch_sec": round(scale_epoch, 4),
@@ -595,4 +622,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    main(smoke="--smoke" in sys.argv[1:])
