@@ -254,8 +254,8 @@ def choose_server(user_factors, item_factors,
 class _PendingQuery:
     __slots__ = ("uid", "k", "done", "result", "error")
 
-    def __init__(self, uid: int, k: int):
-        self.uid = uid
+    def __init__(self, uid, k: int):
+        self.uid = uid        # user index, or an item-index tuple
         self.k = k
         self.done = threading.Event()
         self.result = None
@@ -263,18 +263,21 @@ class _PendingQuery:
 
 
 class _MicroBatcher:
-    """Cross-request micro-batching for per-user device queries
-    (round-4 verdict weak #5: concurrent single-query REST clients each
-    paid their own device dispatch serially).
+    """Cross-request micro-batching for device queries (round-4 verdict
+    weak #5: concurrent single-query REST clients each paid their own
+    device dispatch serially).
 
-    Callers enqueue (uid, k) and block on a per-request event; one
-    dispatcher thread drains EVERYTHING pending into a single
-    ``users_topk`` dispatch. No artificial wait window: while a device
-    dispatch is in flight, new arrivals pile up and form the next batch
-    — at low load a query pays one dispatch exactly as before, under
-    load throughput approaches the batched-program rate instead of
-    one transport round trip per query (the live-server application of
-    ``P2LAlgorithm.scala:66-68`` batch semantics)."""
+    Callers enqueue a request and block on a per-request event; one
+    dispatcher thread drains EVERYTHING pending into a single batched
+    dispatch (``_dispatch_group``, subclass-provided). No artificial
+    wait window: while a device dispatch is in flight, new arrivals
+    pile up and form the next batch — at low load a query pays one
+    dispatch exactly as before, under load throughput approaches the
+    batched-program rate instead of one transport round trip per query
+    (the live-server application of ``P2LAlgorithm.scala:66-68`` batch
+    semantics)."""
+
+    name = "pio-microbatch"
 
     def __init__(self, server: "DeviceTopK", max_batch: int = 256):
         import weakref
@@ -290,14 +293,14 @@ class _MicroBatcher:
         self.dispatches = 0      # stats: device dispatches issued
         self.batched_queries = 0  # stats: queries served through them
 
-    def submit(self, uid: int, k: int):
+    def submit(self, uid, k: int):
         item = _PendingQuery(uid, k)
         with self._cv:
             if self._closed:
                 raise RuntimeError("serving backend is closed")
             if self._thread is None:
                 self._thread = threading.Thread(
-                    target=self._run, daemon=True, name="pio-microbatch")
+                    target=self._run, daemon=True, name=self.name)
                 self._thread.start()
             self._pending.append(item)
             self._cv.notify()
@@ -332,27 +335,9 @@ class _MicroBatcher:
             try:
                 if srv is None:
                     raise RuntimeError("serving backend was released")
-                kmax = max(it.k for it in group)
-                n = len(group)
-                uids = np.asarray([it.uid for it in group],
-                                  dtype=np.int64)
-                if n > 8:
-                    # pad to the ONE large uid bucket so live traffic
-                    # only ever needs the two batch programs warmup
-                    # compiled (8 and max_batch) — hard part #4: no
-                    # query may pay a serve-time XLA compile
-                    padded = np.zeros(self._max, dtype=np.int64)
-                    padded[:n] = uids
-                    idx, scores = srv.users_topk(padded, kmax)
-                else:
-                    idx, scores = srv.users_topk(uids, kmax)
+                self._dispatch_group(srv, group)
                 self.dispatches += 1
-                self.batched_queries += n
-                for row, it in enumerate(group):
-                    ri = idx[row, :it.k]
-                    rs = scores[row, :it.k]
-                    valid = np.isfinite(rs)
-                    it.result = (ri[valid], rs[valid])
+                self.batched_queries += len(group)
             except BaseException as e:  # propagate to every waiter
                 for it in group:
                     it.error = e
@@ -360,6 +345,66 @@ class _MicroBatcher:
                 del srv  # never hold the server across the cv wait
                 for it in group:
                     it.done.set()
+
+    def _dispatch_group(self, srv: "DeviceTopK",
+                        group: List[_PendingQuery]) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def _scatter_results(group, idx: np.ndarray,
+                         scores: np.ndarray) -> None:
+        """Row r of the batched (idx, scores) -> request r's result,
+        clipped to its own k with non-candidates filtered."""
+        for row, it in enumerate(group):
+            ri = idx[row, :it.k]
+            rs = scores[row, :it.k]
+            valid = np.isfinite(rs)
+            it.result = (ri[valid], rs[valid])
+
+
+class _UserBatcher(_MicroBatcher):
+    """Per-user top-k requests -> one ``users_topk`` dispatch."""
+
+    def _dispatch_group(self, srv, group):
+        kmax = max(it.k for it in group)
+        n = len(group)
+        uids = np.asarray([it.uid for it in group], dtype=np.int64)
+        if n > 8:
+            # pad to the ONE large uid bucket so live traffic only ever
+            # needs the two batch programs warmup compiled (8 and
+            # max_batch) — hard part #4: no query may pay a serve-time
+            # XLA compile
+            padded = np.zeros(self._max, dtype=np.int64)
+            padded[:n] = uids
+            idx, scores = srv.users_topk(padded, kmax)
+        else:
+            idx, scores = srv.users_topk(uids, kmax)
+        self._scatter_results(group, idx, scores)
+
+
+class _ItemBatcher(_MicroBatcher):
+    """Item-similarity requests (each a tuple of query-item indices) ->
+    one vmapped ``_items_topk`` dispatch. The group pads to 8 or
+    max_batch rows (warmed buckets) and each row's item list to the
+    group's common power-of-two length."""
+
+    name = "pio-microbatch-items"
+
+    def _dispatch_group(self, srv, group):
+        kmax = max(it.k for it in group)
+        n = len(group)
+        B = srv.ITEM_QUERY_BUCKET
+        while B < max(len(it.uid) for it in group):
+            B *= 2
+        G = 8 if n <= 8 else self._max  # the two warmed group buckets
+        idxs = np.zeros((G, B), dtype=np.int32)
+        masks = np.zeros((G, B), dtype=np.float32)
+        for row, it in enumerate(group):
+            m = len(it.uid)
+            idxs[row, :m] = np.asarray(it.uid, dtype=np.int32)
+            masks[row, :m] = 1.0
+        idx, scores = srv._items_topk_batched(idxs, masks, kmax)
+        self._scatter_results(group, idx, scores)
 
 
 class DeviceTopK:
@@ -390,7 +435,9 @@ class DeviceTopK:
             microbatch = os.environ.get(
                 "PIO_SERVING_MICROBATCH",
                 "1").strip().lower() not in ("0", "off", "false")
-        self._batcher = _MicroBatcher(self) if microbatch else None
+        self._batcher = _UserBatcher(self) if microbatch else None
+        self._item_batcher = _ItemBatcher(self, max_batch=64) \
+            if microbatch else None
 
         self._X = (user_factors if hasattr(user_factors, "sharding")
                    else jnp.asarray(user_factors))
@@ -483,12 +530,24 @@ class DeviceTopK:
                 break
             k *= 2
         self.items_topk([0], min(16, self.n_items))
+        if self._item_batcher is not None:
+            # the large item-group bucket at the base item-list length
+            # (queries with longer item lists may still compile at
+            # serve time — same contract as before batching)
+            B = self.ITEM_QUERY_BUCKET
+            for g in (8, self._item_batcher._max):
+                self._items_topk_batched(
+                    np.zeros((g, B), dtype=np.int32),
+                    np.zeros((g, B), dtype=np.float32),
+                    min(16, self.n_items))
 
     def close(self) -> None:
-        """Release the micro-batch dispatcher (idempotent). Dropping the
-        last reference also stops it within its wait timeout."""
+        """Release the micro-batch dispatchers (idempotent). Dropping
+        the last reference also stops them within their wait timeout."""
         if self._batcher is not None:
             self._batcher.close()
+        if self._item_batcher is not None:
+            self._item_batcher.close()
 
     # -- serving ----------------------------------------------------------
 
@@ -537,7 +596,16 @@ class DeviceTopK:
         return idx[:n, :k], scores[:n, :k]
 
     def items_topk(self, idxs, k: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Item-similarity top-k for a list of query item indices."""
+        """Item-similarity top-k for a list of query item indices. With
+        micro-batching on, concurrent callers share one vmapped
+        dispatch (same discipline as ``user_topk``)."""
+        if self._item_batcher is not None:
+            return self._item_batcher.submit(
+                tuple(int(i) for i in idxs), int(k))
+        return self._items_topk_direct(idxs, k)
+
+    def _items_topk_direct(self, idxs,
+                           k: int) -> Tuple[np.ndarray, np.ndarray]:
         import jax.numpy as jnp
 
         B = self.ITEM_QUERY_BUCKET
@@ -561,3 +629,24 @@ class DeviceTopK:
         idx, scores = idx[:k], scores[:k]
         valid = np.isfinite(scores)
         return idx[valid], scores[valid]
+
+    def _items_topk_batched(self, idxs: np.ndarray, masks: np.ndarray,
+                            k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """vmap of the item-similarity program over a [G, B] query
+        bucket: G concurrent item queries, one dispatch, one fetch."""
+        import jax.numpy as jnp
+
+        G, B = idxs.shape
+        kb = min(_bucket(k), self.n_items)
+        prog = self._item_programs.get((kb, B, G))
+        if prog is None:
+            import jax
+
+            prog = jax.jit(jax.vmap(
+                partial(_items_topk, k=kb, n_items=self.n_items),
+                in_axes=(None, 0, 0)))
+            self._item_programs[(kb, B, G)] = prog
+        out = prog(self._normalized_items(), jnp.asarray(idxs),
+                   jnp.asarray(masks))
+        idx, scores = _unpack(np.asarray(out), kb)
+        return idx, scores

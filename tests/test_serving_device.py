@@ -275,6 +275,66 @@ class TestMicroBatching:
         # no NEW batch program was compiled by the 21-query group
         assert set(srv._batch_programs) == compiled
 
+    def test_item_queries_batched_and_correct(self, factors):
+        import threading
+        import time
+
+        X, Y, seen = factors
+        srv = DeviceTopK(X, Y, seen)
+        oracle = DeviceTopK(X, Y, seen, microbatch=False)
+        orig = srv._items_topk_batched
+
+        def slow_batched(idxs, masks, k):
+            time.sleep(0.02)
+            return orig(idxs, masks, k)
+
+        srv._items_topk_batched = slow_batched
+        results = {}
+        errors = []
+
+        def worker(tx):
+            try:
+                for i in range(4):
+                    items = [int(x) for x in
+                             {(tx + i) % 33, (tx * 3 + i) % 33}]
+                    k = 3 + (i % 2)
+                    results[(tx, i)] = (items, k,
+                                        srv.items_topk(items, k))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        b = srv._item_batcher
+        assert b.batched_queries == 24
+        assert b.dispatches < 24
+        for (tx, i), (items, k, (idx, scores)) in results.items():
+            want_idx, want_scores = oracle.items_topk(items, k)
+            assert idx.tolist() == want_idx.tolist(), (items, k)
+            np.testing.assert_allclose(scores, want_scores, rtol=1e-5)
+
+    def test_item_warmup_covers_batcher_buckets(self, factors):
+        X, Y, seen = factors
+        srv = DeviceTopK(X, Y, seen)
+        srv.warmup(max_k=16)
+        compiled = set(srv._item_programs)
+        # a full group of base-length item queries hits warmed programs
+        from predictionio_tpu.ops.serving import _PendingQuery
+
+        b = srv._item_batcher
+        items = [_PendingQuery((u % 33,), 3) for u in range(12)]
+        with b._cv:
+            b._pending.extend(items)
+        b.submit((0,), 3)
+        for it in items:
+            assert it.done.wait(timeout=10) and it.error is None
+        assert set(srv._item_programs) == compiled
+
     def test_close_stops_dispatcher_and_gc_releases(self, factors):
         import gc
         import threading
