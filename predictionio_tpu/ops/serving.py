@@ -606,27 +606,18 @@ class DeviceTopK:
 
     def _items_topk_direct(self, idxs,
                            k: int) -> Tuple[np.ndarray, np.ndarray]:
-        import jax.numpy as jnp
-
+        """Unbatched path: a single-row group through the same vmapped
+        program family the batcher uses (one padding implementation,
+        one program cache)."""
         B = self.ITEM_QUERY_BUCKET
         while B < len(idxs):
             B *= 2
-        pad_idx = np.zeros(B, dtype=np.int32)
-        pad_mask = np.zeros(B, dtype=np.float32)
-        pad_idx[:len(idxs)] = np.asarray(idxs, dtype=np.int32)
-        pad_mask[:len(idxs)] = 1.0
-        kb = min(_bucket(k), self.n_items)
-        prog = self._item_programs.get((kb, B))
-        if prog is None:
-            import jax
-
-            prog = jax.jit(partial(_items_topk, k=kb,
-                                   n_items=self.n_items))
-            self._item_programs[(kb, B)] = prog
-        out = prog(self._normalized_items(), jnp.asarray(pad_idx),
-                   jnp.asarray(pad_mask))
-        idx, scores = _unpack(np.asarray(out), kb)
-        idx, scores = idx[:k], scores[:k]
+        pad_idx = np.zeros((1, B), dtype=np.int32)
+        pad_mask = np.zeros((1, B), dtype=np.float32)
+        pad_idx[0, :len(idxs)] = np.asarray(idxs, dtype=np.int32)
+        pad_mask[0, :len(idxs)] = 1.0
+        idx, scores = self._items_topk_batched(pad_idx, pad_mask, k)
+        idx, scores = idx[0, :k], scores[0, :k]
         valid = np.isfinite(scores)
         return idx[valid], scores[valid]
 

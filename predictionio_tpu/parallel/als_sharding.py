@@ -178,11 +178,12 @@ def train_als_sharded_2d(user_side: PaddedRatings, item_side: PaddedRatings,
                           factor_spec=P("model", None), dtype=dtype)
 
 
-def train_als_device(user_side: PaddedRatings, item_side: PaddedRatings,
+def train_als_device(user_side, item_side,
                      params: ALSParams, mesh=None, dtype=None):
     """Train and KEEP the factors sharded in HBM — the PAlgorithm flavor
     (PAlgorithm.scala:44-126: the model lives distributed; nothing is
-    gathered to host).
+    gathered to host). Accepts uniform :class:`PaddedRatings` or
+    length-bucketed :class:`BucketedRatings` sides.
 
     Returns ``(X, Y)`` as jax Arrays padded to the mesh divisor — on a
     2-D mesh they are row-sharded over the 'model' axis (each device
@@ -205,6 +206,13 @@ def train_als_device(user_side: PaddedRatings, item_side: PaddedRatings,
     else:
         divisor = mesh.devices.size
         spec = P(None, None)
+    if isinstance(user_side, BucketedRatings):
+        # the scale combination: bucketed solves + factors kept in HBM
+        # (model-sharded on a 2-D mesh); note the returned Arrays are
+        # NOT row-padded — bucketed training sizes them exactly
+        return train_als_bucketed_sharded(
+            user_side, item_side, params, mesh, dtype=dtype,
+            factor_spec=spec, gather=False)
     return _train_sharded(user_side, item_side, params, mesh,
                           row_divisor=divisor, factor_spec=spec,
                           dtype=dtype, gather=False)
@@ -230,17 +238,23 @@ def _pad_bucket_rows(b: RatingsBucket, multiple: int,
 
 def train_als_bucketed_sharded(user_side: BucketedRatings,
                                item_side: BucketedRatings,
-                               params: ALSParams, mesh, dtype=None
-                               ) -> Tuple[np.ndarray, np.ndarray]:
+                               params: ALSParams, mesh, dtype=None,
+                               factor_spec=None, gather: bool = True
+                               ) -> Tuple:
     """Length-bucketed training over a device mesh.
 
     Every bucket's table is row-sharded over the mesh's ``data`` axis
     (rows padded to a lane-friendly multiple of the axis size with
-    sentinel ids); the factor matrices stay replicated, so each
-    device's per-bucket solves scatter into its replica and XLA merges
-    the disjoint scatters with one psum per half-step — the collective
-    analog of MLlib's factor shuffle, at bucketed occupancy instead of
-    longest-row padding."""
+    sentinel ids). By default the factor matrices stay replicated, so
+    each device's per-bucket solves scatter into its replica and XLA
+    merges the disjoint scatters with one psum per half-step — the
+    collective analog of MLlib's factor shuffle, at bucketed occupancy
+    instead of longest-row padding. ``factor_spec`` (e.g.
+    ``P("model", None)``) shards the factor matrices instead (the ALX
+    layout's memory step; factor rows pad to the sharded-dim divisor);
+    ``gather=False`` returns the factors as device Arrays in that
+    (row-padded) placement — the PAlgorithm flavor where the model
+    never lands on host."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -248,7 +262,7 @@ def train_als_bucketed_sharded(user_side: BucketedRatings,
     ndev = int(mesh.shape.get("data", 1))
     rows_sharded = NamedSharding(mesh, P("data", None))
     ids_sharded = NamedSharding(mesh, P("data"))
-    repl = NamedSharding(mesh, P(None, None))
+    repl = NamedSharding(mesh, factor_spec or P(None, None))
     put = jax.device_put
     multi_host = len({d.process_index for d in mesh.devices.flat}) > 1
 
@@ -277,15 +291,27 @@ def train_als_bucketed_sharded(user_side: BucketedRatings,
 
     X, Y = init_factors(user_side.n_rows, item_side.n_rows, params.rank,
                         params.seed, dtype)
+    # a sharded factor dim must split evenly: pad rows (with ZEROS — a
+    # random-init pad row would pollute the first shared Gram term) to
+    # the dim-0 axis product; pad rows are never scattered into by a
+    # real bucket row and serving masks them via n_users/n_items
+    dim0 = (factor_spec or P(None, None))[0]
+    names = (dim0,) if isinstance(dim0, str) else tuple(dim0 or ())
+    divisor = 1
+    for a in names:
+        divisor *= int(mesh.shape[a])
+    n_u_pad = -(-user_side.n_rows // divisor) * divisor
+    n_i_pad = -(-item_side.n_rows // divisor) * divisor
+    X = _pad_rows_to(np.asarray(X), n_u_pad)
+    Y = _pad_rows_to(np.asarray(Y), n_i_pad)
     if multi_host:
         from predictionio_tpu.parallel import distributed
 
-        X = distributed.make_global_array(mesh, P(None, None),
-                                          np.asarray(X))
-        Y = distributed.make_global_array(mesh, P(None, None),
-                                          np.asarray(Y))
+        spec = factor_spec or P(None, None)
+        X = distributed.make_global_array(mesh, spec, X)
+        Y = distributed.make_global_array(mesh, spec, Y)
     else:
-        X, Y = put(X, repl), put(Y, repl)
+        X, Y = put(jnp.asarray(X), repl), put(jnp.asarray(Y), repl)
     fn = jax.jit(
         _als_iterations_bucketed_impl,
         static_argnames=("lam", "alpha", "implicit", "num_iterations",
@@ -297,7 +323,13 @@ def train_als_bucketed_sharded(user_side: BucketedRatings,
               num_iterations=int(params.num_iterations),
               slot_budget=None if not params.bucket_slot_budget
               else int(params.bucket_slot_budget))
-    return np.asarray(X), np.asarray(Y)
+    if not gather:
+        # PAlgorithm flavor: factors stay in HBM in their sharded
+        # placement (rows padded to the factor divisor); serve via
+        # ops.serving.DeviceTopK with the true n_users/n_items bounds
+        return X, Y
+    return (np.asarray(X)[:user_side.n_rows],
+            np.asarray(Y)[:item_side.n_rows])
 
 
 def train_als_auto(user_side, item_side, params: ALSParams, dtype=None

@@ -494,6 +494,63 @@ class TestShardedFlavor:
         full = algo.predict(model, Query(user="u1", num=50))
         assert not ({s.item for s in full.item_scores} & seen_items)
 
+    def test_bucketed_device_resident_matches_uniform(self, mem_storage):
+        """The scale combination: bucketed-layout training with the
+        factors kept sharded in HBM — same predictions as the uniform
+        device-resident flavor."""
+        from predictionio_tpu.templates.recommendation import (
+            PreparatorParams, Query, ShardedALSModel,
+            sharded_engine_factory,
+        )
+
+        _seed()
+        engine = sharded_engine_factory()
+        uniform_params = _engine_params()
+        bucketed_params = EngineParams(
+            data_source_params=uniform_params.data_source_params,
+            preparator_params=("", PreparatorParams(bucketed=True)),
+            algorithm_params_list=uniform_params.algorithm_params_list)
+
+        def deploy(params, iid):
+            persistable = engine.train(CTX, params, iid)
+            [model] = engine.prepare_deploy(CTX, params, iid, persistable)
+            return engine._algorithms(params)[0], model
+
+        algo_u, model_u = deploy(uniform_params, "du")
+        algo_b, model_b = deploy(bucketed_params, "db")
+        assert isinstance(model_b, ShardedALSModel)
+        assert hasattr(model_b.user_factors, "sharding")
+        for u in ("u1", "u7", "u15"):
+            ru = algo_u.predict(model_u, Query(user=u, num=5))
+            rb = algo_b.predict(model_b, Query(user=u, num=5))
+            assert [s.item for s in rb.item_scores] == \
+                [s.item for s in ru.item_scores], u
+            np.testing.assert_allclose(
+                [s.score for s in rb.item_scores],
+                [s.score for s in ru.item_scores], rtol=1e-3)
+
+    def test_bucketed_device_resident_uneven_rows(self):
+        """Regression: user/item counts NOT divisible by the model-axis
+        size must still train (factor rows pad to the divisor; serving
+        masks the pad rows)."""
+        from predictionio_tpu.ops.als import bucket_ratings_pair
+        from predictionio_tpu.ops.serving import DeviceTopK
+        from predictionio_tpu.parallel.als_sharding import train_als_device
+
+        rng = np.random.default_rng(4)
+        n_u, n_i = 21, 13  # both odd: indivisible by model=2 and data
+        rows = rng.integers(0, n_u, 300)
+        cols = rng.integers(0, n_i, 300)
+        vals = rng.random(300).astype(np.float32) + 0.5
+        ub, ib = bucket_ratings_pair(rows, cols, vals, n_u, n_i)
+        X, Y = train_als_device(ub, ib, ALSParams(rank=4,
+                                                  num_iterations=2,
+                                                  seed=0))
+        assert X.shape[0] >= n_u and Y.shape[0] >= n_i
+        srv = DeviceTopK(X, Y, None, n_users=n_u, n_items=n_i)
+        idx, scores = srv.user_topk(3, 5)
+        assert (idx < n_i).all() and np.isfinite(scores).all()
+
     def test_batch_predict_matches_per_query(self, mem_storage):
         """batch_predict groups user queries into users_topk dispatches;
         results must equal the per-query path, including blacklists,
