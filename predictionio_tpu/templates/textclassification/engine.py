@@ -36,6 +36,9 @@ import numpy as np
 
 from predictionio_tpu.controller import (
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     LFirstServing,
     P2LAlgorithm,
     Params,
@@ -442,6 +445,38 @@ class Accuracy(AverageMetric):
 
     def calculate_qpa(self, q, p, a) -> float:
         return 1.0 if p.label == a.label else 0.0
+
+
+class TextParamsList(EngineParamsGenerator):
+    """Tuning grid: NB smoothing vs LR capacity (EngineParamsGenerator
+    shape of the reference's evaluation templates)."""
+
+    def __init__(self, app_name: str = "text-app"):
+        super().__init__()
+        ds = ("", DataSourceParams(app_name=app_name))
+        prep = ("", PreparatorParams())
+        self.engine_params_list = [
+            EngineParams(data_source_params=ds, preparator_params=prep,
+                         algorithm_params_list=[
+                             ("nb", TextNBParams(lambda_=lam))])
+            for lam in (0.1, 1.0)
+        ] + [
+            EngineParams(data_source_params=ds, preparator_params=prep,
+                         algorithm_params_list=[
+                             ("lr", TextLRParams(embedding_dim=dim,
+                                                 epochs=20, seed=1))])
+            for dim in (16, 64)
+        ]
+
+
+class TextEvaluation(Evaluation, TextParamsList):
+    """``pio eval`` entry: the 4-point NB/LR grid scored by Accuracy
+    over the k-fold split; best params land in best.json."""
+
+    def __init__(self, app_name: str = "text-app"):
+        Evaluation.__init__(self)
+        TextParamsList.__init__(self, app_name=app_name)
+        self.engine_metric = (engine_factory(), Accuracy())
 
 
 def engine_factory() -> Engine:

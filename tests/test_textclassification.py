@@ -175,3 +175,44 @@ class TestEndToEnd:
         docs = [Document(text="aaa bbb", label="only")] * 5
         with pytest.raises(AssertionError, match="distinct labels"):
             _train_engine("nb", TextNBParams(), docs)
+
+
+class TestTuning:
+    def test_pio_eval_grid_writes_best(self, mem_storage, tmp_path,
+                                       monkeypatch):
+        """The pio-eval path: MetricEvaluator sweeps the NB/LR grid
+        and records the winner in best.json."""
+        import datetime as dt
+
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.templates.textclassification import (
+            TextEvaluation,
+        )
+        from predictionio_tpu.workflow.core_workflow import run_evaluation
+
+        aid = storage.get_metadata_apps().insert(App(0, "text-app"))
+        le = storage.get_levents()
+        le.init(aid)
+        t0 = dt.datetime(2022, 1, 1, tzinfo=dt.timezone.utc)
+        docs = corpus(n_per_class=15)
+        le.insert_batch(
+            [Event(event="$set", entity_type="doc", entity_id=f"d{i}",
+                   properties={"text": d.text, "label": d.label},
+                   event_time=t0) for i, d in enumerate(docs)], aid)
+
+        monkeypatch.chdir(tmp_path)
+        ev = TextEvaluation()
+        assert len(ev.engine_params_list) == 4
+        from predictionio_tpu.data.storage.base import EvaluationInstance
+
+        now = dt.datetime.now(tz=UTC)
+        instance = EvaluationInstance(id="", status="INIT",
+                                      start_time=now, end_time=now)
+        result = run_evaluation(ev.engine, ev.engine_params_list,
+                                instance, ev.evaluator, evaluation=ev,
+                                ctx=ComputeContext())
+        assert float(result.best_score.score) >= 0.8
+        import json as _json
+        best = _json.loads((tmp_path / "best.json").read_text())
+        assert best["algorithms"]
