@@ -30,6 +30,7 @@ ITERATIONS = 10
 LAMBDA = 0.01
 ALPHA = 1.0
 N_USERS, N_ITEMS, NNZ = 943, 1682, 100_000
+HEADLINE_METRIC = "als_implicit_ml100k_rank64_events_per_sec"
 
 
 def synthetic_ratings(n_users: int, n_items: int, nnz: int, seed: int = 7):
@@ -489,6 +490,48 @@ def serving_bench(X: np.ndarray, Y: np.ndarray, n_queries: int = 300,
     }
 
 
+def _device_watchdog(timeout_sec: float = 300.0) -> None:
+    """Fail LOUDLY if backend init hangs (a dead accelerator tunnel
+    blocks inside the PJRT plugin forever): probe ``jax.devices()`` on a
+    side thread and, past the deadline, print a diagnostic line in the
+    bench's JSON contract and exit — a hang would otherwise leave the
+    round with NO artifact at all. 300s is far beyond a healthy first
+    init (~20-40s)."""
+    import os
+    import threading
+
+    result: dict = {}
+
+    def probe():
+        try:
+            import jax
+
+            result["devices"] = [str(d) for d in jax.devices()]
+        except BaseException as e:  # noqa: BLE001 - reported below
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_sec)
+    if "devices" in result:
+        return
+    if not t.is_alive():
+        # fast init FAILURE, not a hang — surface the real error (the
+        # normal flow would have hit it at first jax use anyway)
+        raise RuntimeError(
+            f"device backend init failed: {result.get('error')}")
+    print(json.dumps({
+        "metric": HEADLINE_METRIC,
+        "value": 0,
+        "unit": "events/s/chip",
+        "vs_baseline": 0,
+        "error": (f"device backend init did not respond within "
+                  f"{timeout_sec:.0f}s — accelerator tunnel down; "
+                  "no measurements possible this run"),
+    }), flush=True)
+    os._exit(3)
+
+
 def main(smoke: bool = False) -> None:
     """Full bench, or ``--smoke``: the SAME end-to-end flow at toy
     shapes (runs in ~4 min on CPU) — an integration check that every
@@ -502,6 +545,8 @@ def main(smoke: bool = False) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    _device_watchdog()
 
     from predictionio_tpu.ops.als import ALSParams
 
@@ -576,7 +621,7 @@ def main(smoke: bool = False) -> None:
     import jax
 
     headline = {
-        "metric": "als_implicit_ml100k_rank64_events_per_sec",
+        "metric": HEADLINE_METRIC,
         "value": round(events_per_sec, 1),
         "unit": "events/s/chip",
         "vs_baseline": round(cpu_epoch / device_epoch, 2),
