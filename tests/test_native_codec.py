@@ -260,3 +260,116 @@ class TestImportEquivalence:
         # nothing imported
         assert list(storage.get_levents().find(app_id=1)) == []
         storage.reset()
+
+
+class TestFuzzDifferential:
+    """Randomized event generator vs the python oracle: whatever the
+    C++ codec claims to have parsed natively must agree field-for-field
+    with Event.from_json on the same line; rows it punts on must carry
+    the FALLBACK flag (never silent disagreement)."""
+
+    def _random_event_obj(self, rng):
+        def rstr(pool):
+            n = int(rng.integers(1, 12))
+            return "".join(rng.choice(pool, size=n))
+
+        plain = list("abcdefgh0123XYZ_-")
+        spicy = list("abc\"\\\t\né☃𝄞:{}[],'/ ")
+        pool = plain if rng.random() < 0.6 else spicy
+        o = {"event": rstr(plain) if rng.random() < 0.9 else "$set",
+             "entityType": "user",
+             "entityId": rstr(pool)}
+        if o["event"] == "$set" or rng.random() < 0.5:
+            props = {}
+            for _ in range(int(rng.integers(0, 4))):
+                key = rstr(plain)
+                roll = rng.random()
+                if roll < 0.3:
+                    props[key] = float(rng.normal())
+                elif roll < 0.5:
+                    props[key] = int(rng.integers(-10, 10))
+                elif roll < 0.7:
+                    props[key] = rstr(pool)
+                elif roll < 0.85:
+                    props[key] = [1, rstr(pool), None]
+                else:
+                    props[key] = {"deep": {"er": rstr(pool)}}
+            if o["event"] == "$set" and not props:
+                props = {"x": 1}
+            o["properties"] = props
+        if o["event"] != "$set" and rng.random() < 0.6:
+            o["targetEntityType"] = "item"
+            o["targetEntityId"] = rstr(pool)
+        roll = rng.random()
+        if roll < 0.4:
+            o["eventTime"] = (
+                f"20{rng.integers(10, 30):02d}-"
+                f"{rng.integers(1, 13):02d}-"
+                f"{rng.integers(1, 29):02d}T"
+                f"{rng.integers(0, 24):02d}:"
+                f"{rng.integers(0, 60):02d}:"
+                f"{rng.integers(0, 60):02d}"
+                + ("Z" if rng.random() < 0.5 else "+05:30"))
+        elif roll < 0.6:
+            o["eventTime"] = int(rng.integers(1, 2_000_000_000_000))
+        if rng.random() < 0.2:
+            o["tags"] = [rstr(plain), rstr(pool)]
+        if rng.random() < 0.2:
+            o["prId"] = rstr(plain)
+        return o
+
+    def test_500_random_events_agree_with_oracle(self):
+        rng = np.random.default_rng(20260730)
+        objs = [self._random_event_obj(rng) for _ in range(500)]
+        lines = [json.dumps(o, ensure_ascii=bool(rng.integers(0, 2)))
+                 for o in objs]
+        parsed = codec.parse_jsonl(("\n".join(lines)).encode("utf-8"))
+        assert parsed is not None and len(parsed) == 500
+        fallbacks = 0
+        for i, line in enumerate(lines):
+            ev = Event.from_json(line)
+            if parsed.flags[i] & codec.FALLBACK:
+                fallbacks += 1
+                continue  # honest punt — the python oracle handles it
+            assert parsed.event[i] == ev.event, line
+            assert parsed.entity_id[i] == ev.entity_id, line
+            assert parsed.target_entity_type[i] == \
+                ev.target_entity_type, line
+            assert parsed.target_entity_id[i] == ev.target_entity_id, line
+            assert parsed.pr_id[i] == ev.pr_id, line
+            props = json.loads(parsed.properties_json[i] or "{}")
+            assert props == ev.properties.fields, line
+            tags = json.loads(parsed.tags_json[i] or "[]")
+            assert tuple(tags) == ev.tags, line
+            if not math.isnan(parsed.event_time[i]):
+                assert parsed.event_time[i] == pytest.approx(
+                    ev.event_time.timestamp(), abs=1e-6), line
+        # the fast lane must stay the bulk path on realistic data
+        assert fallbacks < 250, fallbacks
+
+    def test_fuzz_through_store_roundtrip(self, tmp_path):
+        """The same random corpus through a jsonlfs store: find_columnar
+        (codec lane) returns exactly the events the typed reader sees."""
+        from predictionio_tpu.data.storage.jsonlfs import JsonlFsPEvents
+
+        rng = np.random.default_rng(7)
+        objs = [self._random_event_obj(rng) for _ in range(200)]
+        # store-facing rows need event ids + valid times for ordering
+        pe = JsonlFsPEvents({"path": str(tmp_path / "ev"),
+                             "part_max_events": 64})
+        pe._l.init(1)
+        events = [Event.from_json(json.dumps(o)) for o in objs]
+        pe._l.insert_batch(events, 1)
+        typed = list(pe._l.find(app_id=1, limit=-1))
+        batch = pe.find_columnar(1)
+        assert len(batch) == len(typed) == 200
+        got = sorted(zip(batch.events.tolist(),
+                         batch.entity_ids.tolist(),
+                         [t if t is not None else ""
+                          for t in batch.target_ids.tolist()],
+                         np.round(batch.event_times, 6).tolist()))
+        want = sorted((e.event, e.entity_id,
+                       e.target_entity_id or "",
+                       round(e.event_time.timestamp(), 6))
+                      for e in typed)
+        assert got == want
