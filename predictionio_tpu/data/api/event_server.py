@@ -57,11 +57,17 @@ class EventServerConfig:
     architecture ``Storage.scala:360-391`` gets from remote HBase/JDBC
     services. It is a storage credential (the analog of the DB password
     in the reference's storage config), distinct from per-app access
-    keys; unset = the wire is disabled."""
+    keys; unset = the wire is disabled.
+
+    ``server_config_path`` names a server.json whose ``ssl`` section
+    (certfile/keyfile) serves the whole API over TLS — net-new vs the
+    reference's plain-HTTP event server, and what keeps access keys and
+    the service key off the wire in cleartext."""
     ip: str = "0.0.0.0"
     port: int = 7070
     stats: bool = False
     service_key: Optional[str] = None
+    server_config_path: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -97,19 +103,37 @@ class EventServer:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "EventServer":
+        from predictionio_tpu.common import SSLConfiguration
+        from predictionio_tpu.common.auth import (
+            ServerConfig as AuthServerConfig,
+        )
+
         server = self
 
         class Handler(_EventHandler):
             event_server = server
 
+        # TLS only when a server.json is NAMED: the cwd/server.json
+        # fallback ServerConfig.load applies elsewhere must not flip a
+        # plain `pio eventserver` to HTTPS because a deploy config
+        # happens to sit in the working directory
+        if self.config.server_config_path:
+            sslc = SSLConfiguration(
+                AuthServerConfig.load(self.config.server_config_path))
+        else:
+            sslc = SSLConfiguration(AuthServerConfig())
+        self.scheme = "https" if sslc.enabled else "http"
         self._httpd = ThreadingHTTPServer((self.config.ip, self.config.port),
                                           Handler)
+        if sslc.enabled:
+            sslc.wrap_server(self._httpd)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="pio-eventserver",
             daemon=True)
         self._thread.start()
-        logger.info("Event server started on %s:%d", *self.address)
+        logger.info("Event server started on %s://%s:%d", self.scheme,
+                    *self.address)
         return self
 
     @property

@@ -19,9 +19,12 @@ event-JSONL every other component speaks.
   dictionary codes. The network ships bytes; the training host pays the
   decode, exactly like a remote HBase scan.
 
-Config (``PIO_STORAGE_SOURCES_<NAME>_{URL,SERVICE_KEY,TIMEOUT}``):
-``url`` e.g. ``http://eventhost:7070``; ``service_key`` must match the
-server's ``--service-key``. Only the event DAOs exist — configure this
+Config (``PIO_STORAGE_SOURCES_<NAME>_{URL,SERVICE_KEY,TIMEOUT,
+CA_FILE,INSECURE_SKIP_VERIFY}``): ``url`` e.g.
+``http(s)://eventhost:7070``; ``service_key`` must match the server's
+``--service-key``; for ``https`` URLs ``ca_file`` pins the server's
+(typically self-signed) certificate; ``verify_hostname=false`` for
+IP-only deployments with CN-only certs. Only the event DAOs exist — configure this
 source for EVENTDATA and keep METADATA/MODELDATA local (the registry
 raises per-kind capability errors otherwise).
 """
@@ -40,13 +43,40 @@ from predictionio_tpu.data.storage.base import UNSET, StorageError
 
 
 class _Wire:
-    """Shared HTTP plumbing for the storage wire."""
+    """Shared HTTP plumbing for the storage wire.
+
+    For an ``https://`` URL, ``ca_file`` pins the server certificate
+    (the usual self-signed deployment); ``insecure_skip_verify`` (bool)
+    disables verification entirely — test rigs only."""
 
     def __init__(self, config: Optional[dict] = None):
         cfg = config or {}
         self.url = (cfg.get("url") or "http://127.0.0.1:7070").rstrip("/")
         self.service_key = cfg.get("service_key") or ""
         self.timeout = float(cfg.get("timeout", 60))
+        self._ssl_ctx = None
+        if self.url.startswith("https://"):
+            import ssl
+
+            ca = cfg.get("ca_file")
+            skip = str(cfg.get("insecure_skip_verify", "")
+                       ).strip().lower() in ("1", "true", "yes")
+            ctx = ssl.create_default_context(cafile=ca or None)
+            # hostname verification stays ON by default even with a
+            # pinned ca_file (a CA bundle signs many hosts); IP-only
+            # deployments with CN-only self-signed certs opt out via
+            # verify_hostname=false
+            if str(cfg.get("verify_hostname", "")
+                   ).strip().lower() in ("0", "false", "no"):
+                ctx.check_hostname = False
+            if skip:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            self._ssl_ctx = ctx
+
+    def _open(self, req):
+        return urllib.request.urlopen(req, timeout=self.timeout,
+                                      context=self._ssl_ctx)
 
     def _full(self, path: str, params: dict) -> str:
         q = {"serviceKey": self.service_key}
@@ -62,7 +92,7 @@ class _Wire:
         if body is not None:
             req.add_header("Content-Type", "application/x-jsonlines")
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with self._open(req) as resp:
                 payload = json.loads(resp.read().decode("utf-8"))
                 status = resp.status
         except urllib.error.HTTPError as e:
@@ -71,7 +101,10 @@ class _Wire:
                 payload = json.loads(e.read().decode("utf-8"))
             except Exception:
                 payload = {"message": str(e)}
-        except urllib.error.URLError as e:
+        except OSError as e:  # URLError is an OSError subclass
+            # also covers connection-level failures urlopen does not
+            # wrap (e.g. RemoteDisconnected from plain HTTP hitting a
+            # TLS listener)
             raise StorageError(
                 f"event server unreachable at {self.url}: {e}") from e
         if status not in ok:
@@ -85,7 +118,7 @@ class _Wire:
         req = urllib.request.Request(
             self._full("/storage/events.jsonl", params), method="GET")
         try:
-            resp = urllib.request.urlopen(req, timeout=self.timeout)
+            resp = self._open(req)
         except urllib.error.HTTPError as e:
             try:
                 msg = json.loads(e.read().decode("utf-8")).get("message")
@@ -93,7 +126,7 @@ class _Wire:
                 msg = str(e)
             raise StorageError(
                 f"GET /storage/events.jsonl -> {e.code}: {msg}") from e
-        except urllib.error.URLError as e:
+        except OSError as e:  # URLError is an OSError subclass
             raise StorageError(
                 f"event server unreachable at {self.url}: {e}") from e
 

@@ -205,6 +205,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="enable the /storage wire for remote resthttp storage "
              "clients (a storage credential, like a DB password; env "
              "PIO_EVENTSERVER_SERVICE_KEY)")
+    es.add_argument(
+        "--server-config", default=None, metavar="JSON",
+        help="server.json with an ssl section (certfile/keyfile) to "
+             "serve the whole event API over TLS")
     es.set_defaults(func=run_commands.cmd_eventserver)
 
     adm = sub.add_parser("adminserver", help="start the admin REST server")

@@ -222,9 +222,10 @@ def cmd_eventserver(args) -> int:
         or os.environ.get("PIO_EVENTSERVER_SERVICE_KEY") or None
     server = EventServer(EventServerConfig(
         ip=args.ip, port=args.port, stats=args.stats,
-        service_key=service_key)).start()
+        service_key=service_key,
+        server_config_path=getattr(args, "server_config", None))).start()
     host, port = server.address
-    print(f"[INFO] Event Server is ready at http://{host}:{port}.")
+    print(f"[INFO] Event Server is ready at {server.scheme}://{host}:{port}.")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

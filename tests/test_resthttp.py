@@ -232,3 +232,77 @@ class TestRemoteTraining:
             assert 0 < len(res.item_scores) <= 3
         finally:
             storage.reset()
+
+
+class TestWireOverTLS:
+    """The storage wire carries a credential; the event server can serve
+    the whole API over TLS (net-new vs the reference's plain-HTTP event
+    server) and the resthttp client pins the cert via ca_file."""
+
+    @pytest.fixture
+    def tls_server(self, tmp_path):
+        import json as _json
+        import subprocess
+
+        from predictionio_tpu.data import storage as storage_mod
+        from predictionio_tpu.data.api.event_server import (
+            EventServer, EventServerConfig,
+        )
+
+        cert, key = tmp_path / "cert.pem", tmp_path / "key.pem"
+        try:
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-nodes", "-keyout", str(key), "-out", str(cert),
+                 "-days", "1", "-subj", "/CN=localhost"],
+                check=True, capture_output=True, timeout=60)
+        except (OSError, subprocess.SubprocessError):
+            pytest.skip("openssl unavailable")
+        server_json = tmp_path / "server.json"
+        server_json.write_text(_json.dumps(
+            {"ssl": {"certfile": str(cert), "keyfile": str(key)}}))
+        reg = storage_mod.StorageRegistry(storage_mod.StorageConfig(
+            sources={"EV": {"type": "jsonlfs",
+                            "path": str(tmp_path / "events")},
+                     "META": {"type": "memory"}},
+            repositories={"EVENTDATA": "EV", "METADATA": "META",
+                          "MODELDATA": "META"}))
+        server = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0, service_key=KEY,
+                              server_config_path=str(server_json)),
+            reg=reg).start()
+        assert server.scheme == "https"
+        host, port = server.address
+        yield f"https://{host}:{port}", str(cert)
+        server.stop()
+
+    def test_crud_and_stream_over_tls(self, tls_server):
+        url, cert = tls_server
+        le = RestLEvents({"url": url, "service_key": KEY,
+                          "ca_file": cert,
+                          "verify_hostname": "false"})
+        le.init(90)
+        le.insert_batch(
+            [Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                   target_entity_type="item", target_entity_id="i1",
+                   properties={"rating": float(i % 5)}, event_time=t(i))
+             for i in range(30)], 90)
+        assert len(list(le.find(app_id=90, limit=-1))) == 30
+        pe = RestPEvents({"url": url, "service_key": KEY,
+                          "ca_file": cert,
+                          "verify_hostname": "false"})
+        batch = pe.find_columnar(90, value_property="rating")
+        assert len(batch) == 30
+
+    def test_untrusted_client_rejected(self, tls_server):
+        url, _cert = tls_server
+        le = RestLEvents({"url": url, "service_key": KEY})  # no ca_file
+        with pytest.raises(StorageError, match="unreachable|certificate"):
+            le.init(91)
+
+    def test_plain_http_to_tls_port_fails(self, tls_server):
+        url, cert = tls_server
+        le = RestLEvents({"url": url.replace("https://", "http://"),
+                          "service_key": KEY})
+        with pytest.raises(StorageError):
+            le.init(92)
