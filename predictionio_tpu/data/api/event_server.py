@@ -378,7 +378,9 @@ class EventServer:
         if app_id is None:
             raise _HttpError(400, {"message": "appId is required"})
         ch = _first(query, "channelId")
-        return int(app_id), (int(ch) if ch is not None else None)
+        # malformed numbers are client errors, not 500s
+        return (_int_param(app_id, "appId"),
+                _int_param(ch, "channelId") if ch is not None else None)
 
     def storage_init(self, query) -> Tuple[int, Any]:
         app_id, ch = self._storage_scope(query)
@@ -413,14 +415,35 @@ class EventServer:
             self.event_client.delete(event_id, app_id, ch))}
 
     def storage_delete_until(self, query) -> Tuple[int, Any]:
-        from predictionio_tpu.data.event import _parse_time
-
         app_id, ch = self._storage_scope(query)
-        until = _parse_time(_first(query, "untilTime"))
+        until = _time_param(query, "untilTime")
         if until is None:
             return 400, {"message": "untilTime is required"}
         return 200, {"removed":
                      self.event_client.delete_until(app_id, until, ch)}
+
+    def storage_aggregate(self, query) -> Tuple[int, Any]:
+        """Server-side ``aggregate_properties`` for the remote-DAO lane:
+        unbounded calls answer from the backend's MATERIALIZED state, so
+        a remote training host downloads current entities, not event
+        history (the hot `PEventStore.aggregate_properties` shape)."""
+        app_id, ch = self._storage_scope(query)
+        entity_type = _first(query, "entityType")
+        if not entity_type:
+            return 400, {"message": "entityType is required"}
+        props = self.event_client.aggregate_properties(
+            app_id, entity_type, channel_id=ch,
+            start_time=_time_param(query, "startTime"),
+            until_time=_time_param(query, "untilTime"))
+        out = {}
+        for eid, pm in props.items():
+            rec: Dict[str, Any] = {"properties": pm.fields}
+            if pm.first_updated is not None:
+                rec["firstUpdatedT"] = pm.first_updated.isoformat()
+            if pm.last_updated is not None:
+                rec["lastUpdatedT"] = pm.last_updated.isoformat()
+            out[eid] = rec
+        return 200, out
 
     _STORAGE_FILTER_KEYS = ("startTime", "untilTime", "entityType",
                             "entityId", "event", "targetEntityType",
@@ -451,8 +474,6 @@ class EventServer:
                             yield chunk
             return raw_parts()
 
-        from predictionio_tpu.data.event import _parse_time
-
         tet = _first(query, "targetEntityType")
         if _first(query, "targetEntityTypeNull") == "true":
             tet = None
@@ -466,13 +487,14 @@ class EventServer:
         limit_s = _first(query, "limit")
         events = le.find(
             app_id=app_id, channel_id=ch,
-            start_time=_parse_time(_first(query, "startTime")),
-            until_time=_parse_time(_first(query, "untilTime")),
+            start_time=_time_param(query, "startTime"),
+            until_time=_time_param(query, "untilTime"),
             entity_type=_first(query, "entityType"),
             entity_id=_first(query, "entityId"),
             event_names=query.get("event") or None,
             target_entity_type=tet, target_entity_id=tei,
-            limit=int(limit_s) if limit_s is not None else None,
+            limit=_int_param(limit_s, "limit") if limit_s is not None
+            else None,
             reversed=_first(query, "reversed") == "true",
         )
 
@@ -491,6 +513,23 @@ class EventServer:
 def _first(query: Dict[str, List[str]], key: str) -> Optional[str]:
     vals = query.get(key)
     return vals[0] if vals else None
+
+
+def _int_param(raw: str, name: str) -> int:
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise _HttpError(400, {"message": f"invalid {name}: {raw!r}"})
+
+
+def _time_param(query: Dict[str, List[str]], name: str):
+    from predictionio_tpu.data.event import EventValidationError, _parse_time
+
+    raw = _first(query, name)
+    try:
+        return _parse_time(raw)
+    except (EventValidationError, ValueError):
+        raise _HttpError(400, {"message": f"invalid {name}: {raw!r}"})
 
 
 def _parse_event_dict(d: Any) -> Event:
@@ -663,6 +702,9 @@ class _EventHandler(BaseHTTPRequestHandler):
             return
         elif path == "/storage/delete_until.json" and method == "POST":
             self._respond(*srv.storage_delete_until(query))
+            return
+        elif path == "/storage/aggregate.json" and method == "GET":
+            self._respond(*srv.storage_aggregate(query))
             return
         elif path.startswith("/storage/events/") and path.endswith(".json"):
             # clients percent-encode ids with reserved characters

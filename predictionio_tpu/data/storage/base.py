@@ -108,6 +108,43 @@ class LEvents(abc.ABC):
                 n += 1
         return n
 
+    def materialized_aggregate(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+    ) -> Optional[Dict[str, PropertyMap]]:
+        """Serve the unbounded "state now" aggregation from materialized
+        state, or return ``None`` when this backend keeps none (the
+        caller then falls back to :meth:`aggregate_properties_replay`).
+        An EMPTY scope with materialized support returns ``{}``, never
+        ``None``. Backends maintain this state write-through at insert
+        (sqlite/memory) or as a watermark snapshot + delta replay
+        (jsonlfs); semantics are bit-identical to the replay fold."""
+        return None
+
+    def aggregate_properties_replay(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        """The O(event history) fold over a filtered scan — the reference
+        semantics (LEvents.scala:191-214) and the oracle the materialized
+        path is differentially tested against."""
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=list(aggregate_event_names()),
+        )
+        return _apply_required(aggregate_properties(events), required)
+
     def aggregate_properties(
         self,
         app_id: int,
@@ -118,22 +155,29 @@ class LEvents(abc.ABC):
         required: Optional[Sequence[str]] = None,
     ) -> Dict[str, PropertyMap]:
         """Fold special events into per-entity property state
-        (LEvents.scala:191-214)."""
-        events = self.find(
-            app_id=app_id,
-            channel_id=channel_id,
-            start_time=start_time,
-            until_time=until_time,
-            entity_type=entity_type,
-            event_names=list(aggregate_event_names()),
-        )
-        result = aggregate_properties(events)
-        if required:
-            req = list(required)
-            result = {
-                k: v for k, v in result.items() if all(r in v for r in req)
-            }
+        (LEvents.scala:191-214).
+
+        The unbounded call — the shape every template training read
+        issues — is served from materialized state when the backend
+        keeps it (O(current entities) instead of O(event history)); any
+        ``start_time``/``until_time`` bound falls back to the replay
+        fold so time-travel semantics stay exact."""
+        if start_time is None and until_time is None:
+            result = self.materialized_aggregate(app_id, entity_type,
+                                                 channel_id)
+            if result is not None:
+                return _apply_required(result, required)
+        return self.aggregate_properties_replay(
+            app_id, entity_type, channel_id=channel_id,
+            start_time=start_time, until_time=until_time, required=required)
+
+
+def _apply_required(result: Dict[str, PropertyMap],
+                    required: Optional[Sequence[str]]) -> Dict[str, PropertyMap]:
+    if not required:
         return result
+    req = list(required)
+    return {k: v for k, v in result.items() if all(r in v for r in req)}
 
 
 def aggregate_event_names() -> Tuple[str, str, str]:
@@ -243,12 +287,7 @@ class PEvents(abc.ABC):
             entity_type=entity_type,
             event_names=list(aggregate_event_names()),
         )
-        result = aggregate_properties(events)
-        if required:
-            req = list(required)
-            result = {k: v for k, v in result.items()
-                      if all(r in v for r in req)}
-        return result
+        return _apply_required(aggregate_properties(events), required)
 
 
 class LEventsBackedPEvents(PEvents):
@@ -273,6 +312,15 @@ class LEventsBackedPEvents(PEvents):
     def delete(self, event_ids, app_id, channel_id=None) -> None:
         for eid in event_ids:
             self._l.delete(eid, app_id, channel_id)
+
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None) -> Dict[str, PropertyMap]:
+        """Delegate to the LEvents DAO so training reads ride its
+        materialized state (the base PEvents fold would replay)."""
+        return self._l.aggregate_properties(
+            app_id, entity_type, channel_id=channel_id,
+            start_time=start_time, until_time=until_time, required=required)
 
 
 # ---------------------------------------------------------------------------

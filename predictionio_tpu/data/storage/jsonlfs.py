@@ -32,14 +32,22 @@ from __future__ import annotations
 import contextlib
 import fcntl
 import glob
+import json
 import logging
 import os
 import shutil
 import threading
-from typing import Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from predictionio_tpu.data.aggregator import (
+    AGGREGATOR_EVENT_NAMES,
+    EntityState,
+    fold_events,
+    states_to_property_maps,
+)
+from predictionio_tpu.data.datamap import PropertyMap
 from predictionio_tpu.data.event import (
     Event,
     new_event_id,
@@ -47,9 +55,11 @@ from predictionio_tpu.data.event import (
 )
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import UNSET
+from predictionio_tpu.data.storage.localfs import atomic_write_bytes
 from predictionio_tpu.data.storage.memory import match_event
 
 DEFAULT_PART_MAX_EVENTS = 500_000
+SNAPSHOT_NAME = "props_snapshot.json"
 
 _log = logging.getLogger(__name__)
 
@@ -79,6 +89,10 @@ class JsonlFsLEvents(base.LEvents):
         # dir -> [last_part_index, events_in_last_part, bytes_in_last_part]
         # (byte size validates the cache against other writers' appends)
         self._writers: dict = {}
+        # dir -> {"watermark": {part_basename: byte_offset},
+        #         "states": {etype: {eid: EntityState record}}} — the
+        # entity-props snapshot cache (see materialized_aggregate)
+        self._snapshots: dict = {}
         self._lock = threading.RLock()          # guards dicts only
         self._dir_tlocks: dict = {}             # dir -> threading.RLock
 
@@ -177,6 +191,7 @@ class JsonlFsLEvents(base.LEvents):
         with self._dir_lock(d):
             with self._lock:
                 self._writers.pop(d, None)
+                self._snapshots.pop(d, None)
             # let a failed deletion RAISE (a silent True would report
             # data deleted while partitions remain on disk); the .lock
             # file itself is part of the tree and goes with it
@@ -286,6 +301,7 @@ class JsonlFsLEvents(base.LEvents):
                     os.replace(tmp, part)
                     with self._lock:
                         self._writers.pop(d, None)  # recount on append
+                    self._invalidate_snapshot(d)  # offsets now meaningless
                     return True
         return False
 
@@ -334,6 +350,8 @@ class JsonlFsLEvents(base.LEvents):
                     removed += dropped
             with self._lock:
                 self._writers.pop(d, None)  # recount on next append
+            if removed:
+                self._invalidate_snapshot(d)  # offsets now meaningless
         return removed
 
     def _filter_lines_python(self, data: bytes, cutoff: float):
@@ -364,6 +382,154 @@ class JsonlFsLEvents(base.LEvents):
         if limit is not None and limit >= 0:
             out = out[:limit]
         return iter(out)
+
+    # -- materialized entity-property state (watermark snapshot) ----------
+
+    def _invalidate_snapshot(self, d: str) -> None:
+        """A partition rewrite moved bytes under the recorded offsets —
+        drop the snapshot so the next read refolds from scratch. Caller
+        holds the directory lock."""
+        with self._lock:
+            self._snapshots.pop(d, None)
+        try:
+            os.unlink(os.path.join(d, SNAPSHOT_NAME))
+        except FileNotFoundError:
+            pass
+
+    def _load_snapshot(self, d: str) -> dict:
+        with self._lock:
+            snap = self._snapshots.get(d)
+        if snap is not None and os.path.exists(os.path.join(d,
+                                                            SNAPSHOT_NAME)):
+            # the existence check guards against ANOTHER process having
+            # invalidated (unlinked) the snapshot after a partition
+            # rewrite — our in-memory cache would otherwise survive a
+            # rewrite whose file later grows back past the cached offsets
+            return snap
+        try:
+            with open(os.path.join(d, SNAPSHOT_NAME), "r",
+                      encoding="utf-8") as f:
+                snap = json.load(f)
+            if not isinstance(snap, dict) \
+                    or not isinstance(snap.get("watermark"), dict) \
+                    or not isinstance(snap.get("states"), dict):
+                raise ValueError("malformed snapshot")
+        except (FileNotFoundError, ValueError, json.JSONDecodeError):
+            snap = {"watermark": {}, "states": {}}
+        return snap
+
+    def _delta_lines(self, d: str, parts: List[str],
+                     watermark: Dict[str, int]):
+        """Complete lines appended past the watermark, in file order, plus
+        the advanced watermark. Unterminated tails (in-flight appends) are
+        not consumed — their offset stays before them."""
+        new_mark: Dict[str, int] = {}
+        lines: List[str] = []
+        for part in parts:
+            name = os.path.basename(part)
+            off = int(watermark.get(name, 0))
+            size = os.path.getsize(part)
+            if size > off:
+                with open(part, "rb") as f:
+                    f.seek(off)
+                    data = f.read(size - off)
+                cut = data.rfind(b"\n") + 1
+                for raw in data[:cut].split(b"\n"):
+                    raw = raw.strip()
+                    if raw:
+                        lines.append(raw.decode("utf-8", errors="replace"))
+                off += cut
+            new_mark[name] = off
+        return lines, new_mark
+
+    def materialized_aggregate(self, app_id, entity_type, channel_id=None
+                               ) -> Optional[Dict[str, PropertyMap]]:
+        """Serve ``aggregate_properties`` current-state reads from a
+        watermark snapshot: the fold up to the watermark is persisted in
+        ``props_snapshot.json`` (atomic write), and a read replays only
+        the bytes appended since — O(delta), not O(store). Partition
+        rewrites (delete/delete_until) invalidate the snapshot; an
+        out-of-order append re-derives just the touched entities."""
+        d = self._dir(app_id, channel_id)
+        if not os.path.isdir(d):
+            return {}
+        try:
+            with self._dir_lock(d):
+                snap = self._load_snapshot(d)
+                parts = self._parts(d)
+                names = {os.path.basename(p) for p in parts}
+                stale = [n for n, off in snap["watermark"].items()
+                         if n not in names
+                         or os.path.getsize(os.path.join(d, n)) < off]
+                if stale:
+                    # a rewrite slipped past invalidation (another
+                    # process): offsets are meaningless, refold everything
+                    snap = {"watermark": {}, "states": {}}
+                lines, new_mark = self._delta_lines(d, parts,
+                                                    snap["watermark"])
+                if lines or new_mark != snap["watermark"]:
+                    delta: List[Event] = []
+                    for ln in lines:
+                        # cheap prefilter: a special event's JSON must
+                        # spell its name either literally ('"$set"') or
+                        # with the dollar sign escaped as '\\u0024' (raw
+                        # client lines arrive verbatim) — skip full
+                        # parses for the (dominant) non-special traffic,
+                        # never for a possibly-special line
+                        if '"$' not in ln and '\\u0024' not in ln:
+                            continue
+                        e = _parse_event_line(ln, d)
+                        if e is not None and \
+                                e.event in AGGREGATOR_EVENT_NAMES:
+                            delta.append(e)
+                    self._fold_delta(d, snap, delta)
+                    snap["watermark"] = new_mark
+                    atomic_write_bytes(
+                        os.path.join(d, SNAPSHOT_NAME),
+                        json.dumps(snap, sort_keys=True).encode("utf-8"))
+                with self._lock:
+                    self._snapshots[d] = snap
+                # extract under the dir lock: a concurrent reader's delta
+                # fold mutates these dicts in place
+                states = {eid: EntityState.from_record(rec)
+                          for eid, rec in snap["states"]
+                          .get(entity_type, {}).items()}
+        except OSError:
+            # read-only events directory (snapshot/.lock writes refused)
+            # or fs trouble: stay servable via the pure-read replay
+            return None
+        return states_to_property_maps(states)
+
+    def _fold_delta(self, d: str, snap: dict, delta: List[Event]) -> None:
+        by_entity: Dict[tuple, List[Event]] = {}
+        for e in delta:
+            by_entity.setdefault((e.entity_type, e.entity_id), []).append(e)
+        out_of_order: List[tuple] = []
+        for (etype, eid), evs in by_entity.items():
+            recs = snap["states"].setdefault(etype, {})
+            rec = recs.get(eid)
+            st = None if rec is None else EntityState.from_record(rec)
+            if st is not None and st.last_updated is not None and \
+                    min(e.event_time for e in evs) < st.last_updated:
+                # replay would sort these before already-folded events
+                out_of_order.append((etype, eid))
+                continue
+            recs[eid] = fold_events(evs, st).to_record()
+        if out_of_order:
+            # one full pass re-deriving ONLY the out-of-order entities
+            wanted = set(out_of_order)
+            history: Dict[tuple, List[Event]] = {k: [] for k in wanted}
+            for e in self._iter_events(d):
+                k = (e.entity_type, e.entity_id)
+                if k in history and e.event in AGGREGATOR_EVENT_NAMES:
+                    history[k].append(e)
+            for (etype, eid), evs in history.items():
+                recs = snap["states"].setdefault(etype, {})
+                st = fold_events(evs)
+                if st is None:
+                    recs.pop(eid, None)
+                else:
+                    recs[eid] = st.to_record()
 
 
 class JsonlFsPEvents(base.LEventsBackedPEvents):

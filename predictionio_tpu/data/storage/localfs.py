@@ -23,6 +23,24 @@ from predictionio_tpu.data.storage import base
 _SAFE = re.compile(r"[^A-Za-z0-9._-]")
 
 
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Crash-safe file write: temp file in the target directory + atomic
+    rename, so readers only ever see complete content. Shared by the
+    model blob store below and the jsonlfs entity-props snapshot (the
+    two filesystem stores that persist derived state a crashed writer
+    must never leave torn)."""
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_" + os.path.basename(path))
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic on POSIX
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
 def _fname(mid: str) -> str:
     """Sanitized, INJECTIVE id -> filename mapping: the readable prefix
     cannot escape the directory, and the id-hash suffix keeps distinct
@@ -39,16 +57,7 @@ class LocalFSModels(base.Models):
         os.makedirs(self._dir, exist_ok=True)
 
     def insert(self, m: base.Model) -> None:
-        final = os.path.join(self._dir, _fname(m.id))
-        fd, tmp = tempfile.mkstemp(dir=self._dir, prefix=".tmp_model_")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(m.models)
-            os.replace(tmp, final)  # atomic on POSIX
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        atomic_write_bytes(os.path.join(self._dir, _fname(m.id)), m.models)
 
     def get(self, mid: str) -> Optional[base.Model]:
         path = os.path.join(self._dir, _fname(mid))

@@ -14,6 +14,14 @@ import itertools
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
+from predictionio_tpu.data.aggregator import (
+    AGGREGATOR_EVENT_NAMES,
+    EntityState,
+    fold_event,
+    fold_events,
+    states_to_property_maps,
+)
+from predictionio_tpu.data.datamap import PropertyMap
 from predictionio_tpu.data.event import Event, new_event_id, validate_event
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
@@ -53,6 +61,12 @@ class MemLEvents(base.LEvents):
     def __init__(self, config: Optional[dict] = None):
         # (app_id, channel_id) -> {event_id: Event}; insertion order kept
         self._tables: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
+        # write-through materialized aggregate: the same scope key ->
+        # {(entity_type, entity_id): EntityState}, updated on every
+        # special-event insert/delete — the unbounded
+        # aggregate_properties reads it instead of replaying the table
+        self._props: Dict[Tuple[int, Optional[int]],
+                          Dict[Tuple[str, str], EntityState]] = {}
         self._lock = threading.RLock()
 
     def _key(self, app_id, channel_id):
@@ -65,17 +79,62 @@ class MemLEvents(base.LEvents):
 
     def remove(self, app_id, channel_id=None) -> bool:
         with self._lock:
+            self._props.pop(self._key(app_id, channel_id), None)
             return self._tables.pop(self._key(app_id, channel_id), None) is not None
 
     def close(self) -> None:
         pass
 
+    def _refold_entity_locked(self, key, entity_type: str,
+                              entity_id: str) -> None:
+        """Re-derive ONE entity's state from its (small) event history —
+        the out-of-order / delete repair path. Caller holds the lock."""
+        evs = [e for e in self._tables.get(key, {}).values()
+               if e.entity_type == entity_type and e.entity_id == entity_id
+               and e.event in AGGREGATOR_EVENT_NAMES]
+        props = self._props.setdefault(key, {})
+        st = fold_events(evs)
+        if st is None:
+            props.pop((entity_type, entity_id), None)
+        else:
+            props[(entity_type, entity_id)] = st
+
+    def _fold_in_locked(self, key, event: Event) -> None:
+        if event.event not in AGGREGATOR_EVENT_NAMES:
+            return
+        props = self._props.setdefault(key, {})
+        pkey = (event.entity_type, event.entity_id)
+        st = props.get(pkey)
+        if st is not None and st.last_updated is not None \
+                and event.event_time < st.last_updated:
+            # out-of-order arrival: the replay would fold this BEFORE
+            # already-applied events — re-derive from history instead
+            self._refold_entity_locked(key, *pkey)
+        else:
+            props[pkey] = fold_event(st, event)
+
     def insert(self, event: Event, app_id, channel_id=None) -> str:
         validate_event(event)
         eid = event.event_id or new_event_id()
         with self._lock:
-            table = self._tables.setdefault(self._key(app_id, channel_id), {})
+            key = self._key(app_id, channel_id)
+            table = self._tables.setdefault(key, {})
+            replaced = table.get(eid)
             table[eid] = event.with_id(eid)
+            if replaced is not None:
+                # upsert semantics: the replaced event's fold contribution
+                # is gone — re-derive the touched entities. When NEITHER
+                # side is special the fold state cannot have changed, so
+                # the common idempotent-retry of a non-special event
+                # stays O(1) instead of rescanning the scope.
+                if replaced.event in AGGREGATOR_EVENT_NAMES:
+                    self._refold_entity_locked(
+                        key, replaced.entity_type, replaced.entity_id)
+                if event.event in AGGREGATOR_EVENT_NAMES:
+                    self._refold_entity_locked(
+                        key, event.entity_type, event.entity_id)
+            else:
+                self._fold_in_locked(key, event)
         return eid
 
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
@@ -84,8 +143,21 @@ class MemLEvents(base.LEvents):
 
     def delete(self, event_id, app_id, channel_id=None) -> bool:
         with self._lock:
-            table = self._tables.get(self._key(app_id, channel_id), {})
-            return table.pop(event_id, None) is not None
+            key = self._key(app_id, channel_id)
+            table = self._tables.get(key, {})
+            gone = table.pop(event_id, None)
+            if gone is not None and gone.event in AGGREGATOR_EVENT_NAMES:
+                self._refold_entity_locked(key, gone.entity_type,
+                                           gone.entity_id)
+            return gone is not None
+
+    def materialized_aggregate(self, app_id, entity_type, channel_id=None
+                               ) -> Optional[Dict[str, PropertyMap]]:
+        with self._lock:
+            props = self._props.get(self._key(app_id, channel_id), {})
+            states = {eid: st for (etype, eid), st in props.items()
+                      if etype == entity_type}
+        return states_to_property_maps(states)
 
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,
              entity_type=None, entity_id=None, event_names=None,

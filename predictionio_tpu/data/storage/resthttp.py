@@ -37,7 +37,12 @@ import urllib.parse
 import urllib.request
 from typing import Iterable, List, Optional, Sequence
 
-from predictionio_tpu.data.event import Event, new_event_id, validate_event
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import (
+    Event,
+    new_event_id,
+    validate_event,
+)
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import UNSET, StorageError
 
@@ -217,6 +222,37 @@ class RestLEvents(base.LEvents):
         p["untilTime"] = until_time.isoformat()
         _, payload = self._w.call("POST", "/storage/delete_until.json", p)
         return int(payload.get("removed", 0))
+
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        """Server-side aggregation over the storage wire: the server
+        answers from ITS backend's materialized state (one small JSON
+        of current entities crosses the network, not the event
+        history). A pre-aggregate-route server 404s — fall back to the
+        client-side replay fold over ``find``."""
+        from predictionio_tpu.data.event import _parse_time
+
+        p = _scope(app_id, channel_id)
+        p["entityType"] = entity_type
+        if start_time is not None:
+            p["startTime"] = start_time.isoformat()
+        if until_time is not None:
+            p["untilTime"] = until_time.isoformat()
+        status, payload = self._w.call(
+            "GET", "/storage/aggregate.json", p, ok=(200, 404))
+        if status == 404:
+            return super().aggregate_properties(
+                app_id, entity_type, channel_id=channel_id,
+                start_time=start_time, until_time=until_time,
+                required=required)
+        out = {}
+        for eid, rec in payload.items():
+            out[eid] = PropertyMap(
+                rec.get("properties") or {},
+                first_updated=_parse_time(rec.get("firstUpdatedT")),
+                last_updated=_parse_time(rec.get("lastUpdatedT")))
+        return base._apply_required(out, required)
 
     def find(self, app_id, channel_id=None, start_time=None,
              until_time=None, entity_type=None, entity_id=None,

@@ -8,6 +8,17 @@ Schema notes: one ``events`` table partitioned by (app_id, channel_id)
 columns with a covering index on (app_id, channel_id, event_time) — the
 sqlite analog of the reference's HBase rowkey layout
 (``HBEventsUtil.scala:81-129``: hashed entity prefix ++ event time ++ uuid).
+
+``entity_props`` materializes the ``$set/$unset/$delete`` fold per
+(app, channel, entity_type, entity_id) so the unbounded
+``aggregate_properties`` — every template's training read — is one
+indexed SELECT over current entities instead of an O(event history)
+replay. A scope (app, channel, entity_type) becomes materialized lazily
+on its first unbounded read (one backfill replay, recorded in
+``entity_props_scope``); from then on every insert folds write-through
+in the same transaction. Out-of-order arrivals, event-id upserts and
+deletes re-derive only the touched entity; ``delete_until``/``remove``
+drop the scope rows so the next read backfills fresh.
 """
 
 from __future__ import annotations
@@ -22,7 +33,13 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import dataclasses
 
-from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.aggregator import (
+    AGGREGATOR_EVENT_NAMES,
+    EntityState,
+    fold_event,
+    fold_events,
+)
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
 from predictionio_tpu.data.event import Event, new_event_id, validate_event
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
@@ -50,6 +67,22 @@ CREATE INDEX IF NOT EXISTS idx_events_scan
   ON events (app_id, channel_id, event_time);
 CREATE INDEX IF NOT EXISTS idx_events_entity
   ON events (app_id, channel_id, entity_type, entity_id, event_time);
+CREATE TABLE IF NOT EXISTS entity_props (
+  app_id INTEGER NOT NULL,
+  channel_id INTEGER NOT NULL DEFAULT -1,
+  entity_type TEXT NOT NULL,
+  entity_id TEXT NOT NULL,
+  props TEXT,
+  first_updated REAL,
+  last_updated REAL,
+  PRIMARY KEY (app_id, channel_id, entity_type, entity_id)
+);
+CREATE TABLE IF NOT EXISTS entity_props_scope (
+  app_id INTEGER NOT NULL,
+  channel_id INTEGER NOT NULL DEFAULT -1,
+  entity_type TEXT NOT NULL,
+  PRIMARY KEY (app_id, channel_id, entity_type)
+);
 CREATE TABLE IF NOT EXISTS apps (
   id INTEGER PRIMARY KEY AUTOINCREMENT,
   name TEXT NOT NULL UNIQUE,
@@ -328,7 +361,210 @@ class SqliteLEvents(base.LEvents):
         with self._client.tx() as c:
             c.execute("DELETE FROM events WHERE app_id=? AND channel_id=?",
                       (int(app_id), self._chan(channel_id)))
+            self._drop_materialized(c, int(app_id), self._chan(channel_id))
         return True
+
+    # -- materialized entity-property state -------------------------------
+    # All helpers run on the transaction connection ``c`` so fold
+    # maintenance commits (or rolls back) atomically with the event write.
+
+    @staticmethod
+    def _materialized_scopes(c, aid: int, chan: int) -> set:
+        return {r[0] for r in c.execute(
+            "SELECT entity_type FROM entity_props_scope"
+            " WHERE app_id=? AND channel_id=?", (aid, chan))}
+
+    @staticmethod
+    def _drop_materialized(c, aid: int, chan: int) -> None:
+        c.execute("DELETE FROM entity_props WHERE app_id=? AND channel_id=?",
+                  (aid, chan))
+        c.execute("DELETE FROM entity_props_scope"
+                  " WHERE app_id=? AND channel_id=?", (aid, chan))
+
+    @staticmethod
+    def _load_state(c, aid: int, chan: int, etype: str,
+                    eid: str) -> Optional[EntityState]:
+        row = c.execute(
+            "SELECT props, first_updated, last_updated FROM entity_props"
+            " WHERE app_id=? AND channel_id=? AND entity_type=?"
+            " AND entity_id=?", (aid, chan, etype, eid)).fetchone()
+        if row is None:
+            return None
+        return EntityState.from_record(
+            [None if row[0] is None else json.loads(row[0]), row[1], row[2]])
+
+    @staticmethod
+    def _write_state(c, aid: int, chan: int, etype: str, eid: str,
+                     st: Optional[EntityState]) -> None:
+        if st is None:
+            c.execute(
+                "DELETE FROM entity_props WHERE app_id=? AND channel_id=?"
+                " AND entity_type=? AND entity_id=?", (aid, chan, etype, eid))
+            return
+        rec = st.to_record()
+        c.execute(
+            "INSERT OR REPLACE INTO entity_props (app_id, channel_id,"
+            " entity_type, entity_id, props, first_updated, last_updated)"
+            " VALUES (?,?,?,?,?,?,?)",
+            (aid, chan, etype, eid,
+             None if rec[0] is None else json.dumps(rec[0], sort_keys=True),
+             rec[1], rec[2]))
+
+    def _entity_events(self, c, aid: int, chan: int, etype: str,
+                       eid: str) -> List[Event]:
+        """One entity's special events in replay order (event_time, with
+        rowid breaking ties the same way the index scan does)."""
+        names = ",".join("?" * len(AGGREGATOR_EVENT_NAMES))
+        rows = c.execute(
+            f"SELECT event, properties, event_time FROM events"
+            f" WHERE app_id=? AND channel_id=? AND entity_type=?"
+            f" AND entity_id=? AND event IN ({names})"
+            f" ORDER BY event_time, rowid",
+            (aid, chan, etype, eid) + AGGREGATOR_EVENT_NAMES).fetchall()
+        return [Event(event=name, entity_type=etype, entity_id=eid,
+                      properties=DataMap(json.loads(props)),
+                      event_time=_from_ts(etime))
+                for name, props, etime in rows]
+
+    def _refold_entity(self, c, aid: int, chan: int, etype: str,
+                       eid: str) -> None:
+        """Re-derive ONE entity's state from its (indexed, small) event
+        history — the out-of-order / upsert / delete repair path."""
+        st = None
+        for e in self._entity_events(c, aid, chan, etype, eid):
+            st = fold_event(st, e)
+        self._write_state(c, aid, chan, etype, eid, st)
+
+    def _fold_through(self, c, aid: int, chan: int, events: List[Event],
+                      refold: Optional[set] = None) -> None:
+        """Write-through fold of freshly inserted events (already in the
+        ``events`` table on this transaction). Only scopes a reader has
+        materialized pay anything; entities in ``refold`` (replaced
+        event ids, out-of-order arrivals) re-derive from history, the
+        rest fold incrementally."""
+        special = [e for e in events if e.event in AGGREGATOR_EVENT_NAMES]
+        if not special and not refold:
+            return
+        scopes = self._materialized_scopes(c, aid, chan)
+        if not scopes:
+            return
+        refold = {k for k in (refold or set()) if k[0] in scopes}
+        by_entity: Dict[tuple, List[Event]] = {}
+        for e in special:
+            if e.entity_type in scopes:
+                by_entity.setdefault((e.entity_type, e.entity_id),
+                                     []).append(e)
+        for key, evs in by_entity.items():
+            if key in refold:
+                continue
+            st = self._load_state(c, aid, chan, *key)
+            if st is not None and st.last_updated is not None and \
+                    min(e.event_time for e in evs) < st.last_updated:
+                # out-of-order arrival: the replay would sort this before
+                # already-folded events — re-derive from history
+                refold.add(key)
+                continue
+            self._write_state(c, aid, chan, *key, fold_events(evs, st))
+        for key in refold:
+            self._refold_entity(c, aid, chan, *key)
+
+    def _collision_refolds(self, c, aid: int, chan: int,
+                           events: List[Event]) -> set:
+        """Entities whose fold is invalidated by event-id upserts: the
+        replaced row's contribution disappears, so both the old and the
+        new row's entity must re-derive. Only pre-set event ids can
+        collide (generated ids are fresh UUIDs)."""
+        preset = [e for e in events if e.event_id]
+        refold: set = set()
+        # duplicates WITHIN the batch: only the last row survives the
+        # INSERT OR REPLACE, so every duplicated event's entity must
+        # re-derive from the table instead of being folded incrementally
+        seen: Dict[str, Event] = {}
+        for e in preset:
+            prev = seen.get(e.event_id)
+            if prev is not None:
+                for dup in (prev, e):
+                    if dup.event in AGGREGATOR_EVENT_NAMES:
+                        refold.add((dup.entity_type, dup.entity_id))
+            seen[e.event_id] = e
+        for i in range(0, len(preset), 500):
+            chunk = preset[i:i + 500]
+            marks = ",".join("?" * len(chunk))
+            hits = {r[0]: (r[1], r[2], r[3]) for r in c.execute(
+                f"SELECT event_id, event, entity_type, entity_id FROM events"
+                f" WHERE app_id=? AND channel_id=? AND event_id IN ({marks})",
+                (aid, chan) + tuple(e.event_id for e in chunk))}
+            for e in chunk:
+                hit = hits.get(e.event_id)
+                if hit is None:
+                    continue
+                old_event, old_etype, old_eid = hit
+                if old_event in AGGREGATOR_EVENT_NAMES:
+                    refold.add((old_etype, old_eid))
+                if e.event in AGGREGATOR_EVENT_NAMES:
+                    refold.add((e.entity_type, e.entity_id))
+        return refold
+
+    def materialized_aggregate(self, app_id, entity_type, channel_id=None
+                               ) -> Optional[Dict[str, PropertyMap]]:
+        aid, chan = int(app_id), self._chan(channel_id)
+        try:
+            # scope check, (one-time) backfill and the state read all run
+            # under ONE tx: a concurrent delete_until/remove dropping the
+            # scope can never interleave between the check and the read
+            # (it would hand back an empty table for a non-empty store)
+            with self._client.tx() as c:
+                if c.execute(
+                        "SELECT 1 FROM entity_props_scope WHERE app_id=?"
+                        " AND channel_id=? AND entity_type=?",
+                        (aid, chan, entity_type)).fetchone() is None:
+                    # backfill ONCE: replay the scope's history into
+                    # entity_props (tombstones too) and record the scope.
+                    # The scope row goes in BEFORE scanning: the write
+                    # upgrades this tx to a real write transaction, so a
+                    # concurrent sqlite writer (another process; threads
+                    # already serialize on the tx lock) blocks until the
+                    # backfill commits instead of inserting an event the
+                    # scan missed and the scope-row check skipped
+                    c.execute(
+                        "INSERT OR REPLACE INTO entity_props_scope"
+                        " (app_id, channel_id, entity_type) VALUES (?,?,?)",
+                        (aid, chan, entity_type))
+                    names = ",".join("?" * len(AGGREGATOR_EVENT_NAMES))
+                    rows = c.execute(
+                        f"SELECT entity_id, event, properties, event_time"
+                        f" FROM events WHERE app_id=? AND channel_id=?"
+                        f" AND entity_type=? AND event IN ({names})"
+                        f" ORDER BY event_time, rowid",
+                        (aid, chan, entity_type)
+                        + AGGREGATOR_EVENT_NAMES).fetchall()
+                    states: Dict[str, Optional[EntityState]] = {}
+                    for eid, name, props, etime in rows:
+                        states[eid] = fold_event(
+                            states.get(eid),
+                            Event(event=name, entity_type=entity_type,
+                                  entity_id=eid,
+                                  properties=DataMap(json.loads(props)),
+                                  event_time=_from_ts(etime)))
+                    for eid, st in states.items():
+                        self._write_state(c, aid, chan, entity_type, eid, st)
+                state_rows = c.execute(
+                    "SELECT entity_id, props, first_updated, last_updated"
+                    " FROM entity_props WHERE app_id=? AND channel_id=?"
+                    " AND entity_type=? AND props IS NOT NULL",
+                    (aid, chan, entity_type)).fetchall()
+        except sqlite3.OperationalError:
+            # e.g. a read-only DB file/filesystem rejecting the backfill
+            # write, or lock contention: aggregate_properties must stay
+            # servable — fall back to the pure-read replay
+            return None
+        out: Dict[str, PropertyMap] = {}
+        for eid, props, first, last in state_rows:
+            out[eid] = PropertyMap(
+                json.loads(props),
+                first_updated=None if first is None else _from_ts(first),
+                last_updated=None if last is None else _from_ts(last))
+        return out
 
     def close(self) -> None:
         self._client.close()
@@ -340,44 +576,37 @@ class SqliteLEvents(base.LEvents):
             self._client.release()
 
     def insert(self, event: Event, app_id, channel_id=None) -> str:
-        validate_event(event)
-        eid = event.event_id or new_event_id()
-        with self._client.tx() as c:
-            c.execute(
-                "INSERT OR REPLACE INTO events (event_id, app_id, channel_id,"
-                " event, entity_type, entity_id, target_entity_type,"
-                " target_entity_id, properties, event_time, tags, pr_id,"
-                " creation_time) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                (eid, int(app_id), self._chan(channel_id), event.event,
-                 event.entity_type, event.entity_id, event.target_entity_type,
-                 event.target_entity_id, event.properties.to_json(),
-                 _ts(event.event_time), json.dumps(list(event.tags)),
-                 event.pr_id, _ts(event.creation_time)),
-            )
-        return eid
+        return self.insert_batch([event], app_id, channel_id)[0]
 
     def insert_batch(self, events: Iterable[Event], app_id,
                      channel_id=None) -> List[str]:
         """Bulk insert in one transaction (no reference analog; the TPU
-        ingest path needs it for import throughput)."""
+        ingest path needs it for import throughput). Write-through: the
+        same transaction folds the special events into any materialized
+        entity_props scopes."""
+        aid, chan = int(app_id), self._chan(channel_id)
         ids: List[str] = []
         rows = []
+        evs: List[Event] = []
         for event in events:
             validate_event(event)
             eid = event.event_id or new_event_id()
             ids.append(eid)
+            evs.append(event.with_id(eid))
             rows.append(
-                (eid, int(app_id), self._chan(channel_id), event.event,
+                (eid, aid, chan, event.event,
                  event.entity_type, event.entity_id, event.target_entity_type,
                  event.target_entity_id, event.properties.to_json(),
                  _ts(event.event_time), json.dumps(list(event.tags)),
                  event.pr_id, _ts(event.creation_time)))
         with self._client.tx() as c:
+            refold = self._collision_refolds(c, aid, chan, evs)
             c.executemany(
                 "INSERT OR REPLACE INTO events (event_id, app_id, channel_id,"
                 " event, entity_type, entity_id, target_entity_type,"
                 " target_entity_id, properties, event_time, tags, pr_id,"
                 " creation_time) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", rows)
+            self._fold_through(c, aid, chan, evs, refold)
         return ids
 
     def insert_raw_batch(self, rows: List[tuple], app_id: int,
@@ -392,11 +621,40 @@ class SqliteLEvents(base.LEvents):
         aid, chan = int(app_id), self._chan(channel_id)
         full = [(r[0], aid, chan) + r[1:] for r in rows]
         with self._client.tx() as c:
+            # the fast lane skips per-event fold bookkeeping: entities of
+            # special rows landing in a materialized scope re-derive from
+            # the table after the bulk insert (imports usually target
+            # fresh apps, where no scope is materialized and this is free)
+            scopes = self._materialized_scopes(c, aid, chan)
+            refold = set()
+            if scopes:
+                refold = {(r[2], r[3]) for r in rows
+                          if r[1] in AGGREGATOR_EVENT_NAMES
+                          and r[2] in scopes}
+                # rows replacing an EXISTING special event (id collision)
+                # erase that event's fold contribution too — its entity
+                # must re-derive even if the new row is non-special
+                ids = [r[0] for r in rows]
+                for i in range(0, len(ids), 500):
+                    chunk = ids[i:i + 500]
+                    marks = ",".join("?" * len(chunk))
+                    names = ",".join("?" * len(AGGREGATOR_EVENT_NAMES))
+                    refold.update(
+                        (r[0], r[1]) for r in c.execute(
+                            f"SELECT entity_type, entity_id FROM events"
+                            f" WHERE app_id=? AND channel_id=?"
+                            f" AND event_id IN ({marks})"
+                            f" AND event IN ({names})",
+                            (aid, chan) + tuple(chunk)
+                            + AGGREGATOR_EVENT_NAMES)
+                        if r[0] in scopes)
             c.executemany(
                 "INSERT OR REPLACE INTO events (event_id, app_id, channel_id,"
                 " event, entity_type, entity_id, target_entity_type,"
                 " target_entity_id, properties, event_time, tags, pr_id,"
                 " creation_time) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)", full)
+            for key in refold:
+                self._refold_entity(c, aid, chan, *key)
 
     def iter_raw_rows(self, app_id: int,
                       channel_id: Optional[int] = None):
@@ -418,20 +676,33 @@ class SqliteLEvents(base.LEvents):
         return _row_to_event(row) if row else None
 
     def delete(self, event_id, app_id, channel_id=None) -> bool:
+        aid, chan = int(app_id), self._chan(channel_id)
         with self._client.tx() as c:
+            hit = c.execute(
+                "SELECT event, entity_type, entity_id FROM events"
+                " WHERE app_id=? AND channel_id=? AND event_id=?",
+                (aid, chan, event_id)).fetchone()
             cur = c.execute(
                 "DELETE FROM events WHERE app_id=? AND channel_id=?"
-                " AND event_id=?",
-                (int(app_id), self._chan(channel_id), event_id))
+                " AND event_id=?", (aid, chan, event_id))
+            if cur.rowcount > 0 and hit is not None \
+                    and hit[0] in AGGREGATOR_EVENT_NAMES \
+                    and hit[1] in self._materialized_scopes(c, aid, chan):
+                self._refold_entity(c, aid, chan, hit[1], hit[2])
             return cur.rowcount > 0
 
     def delete_until(self, app_id, until_time, channel_id=None) -> int:
         """One DELETE statement instead of the per-event loop."""
+        aid, chan = int(app_id), self._chan(channel_id)
         with self._client.tx() as c:
             cur = c.execute(
                 "DELETE FROM events WHERE app_id=? AND channel_id=? AND "
-                "event_time<?",
-                (int(app_id), self._chan(channel_id), _ts(until_time)))
+                "event_time<?", (aid, chan, _ts(until_time)))
+            if cur.rowcount:
+                # bulk cutoff touches arbitrarily many entities: drop the
+                # materialized scopes and let the next unbounded read
+                # backfill from the surviving history
+                self._drop_materialized(c, aid, chan)
             return int(cur.rowcount)
 
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,

@@ -70,6 +70,12 @@ class PEventStore:
         until_time: Optional[_dt.datetime] = None,
         required: Optional[Sequence[str]] = None,
     ) -> Dict[str, PropertyMap]:
+        """Current entity-property state for training reads.
+
+        The unbounded call (no ``start_time``/``until_time``) is served
+        from the backend's MATERIALIZED aggregate — O(current entities),
+        not O(event history); bounded calls replay (see
+        ``LEvents.aggregate_properties``)."""
         app_id, channel_id = app_name_to_id(app_name, channel_name)
         return storage.get_pevents().aggregate_properties(
             app_id=app_id, entity_type=entity_type, channel_id=channel_id,

@@ -402,7 +402,7 @@ def zero_empty_rows(X, mask):
 
 
 def _solve_rows(Y, cols, weights, mask, lam: float, alpha: float,
-                implicit: bool, gram=None):
+                implicit: bool, gram=None, solver: Optional[str] = None):
     """Normal-equation solve for one batch of rows: given fixed factors
     ``Y [M, R]`` and padded ratings ``[B, L]`` (+ validity mask), return
     new factors ``[B, R]``. ``gram`` (``Y^T Y``, implicit term) may be
@@ -445,7 +445,7 @@ def _solve_rows(Y, cols, weights, mask, lam: float, alpha: float,
             * jnp.eye(R, dtype=Y.dtype)[None, :, :]
         b = jnp.einsum("bl,blr->br", w, Yg, precision=hi)
 
-    X = _spd_solve(A, b)
+    X = _spd_solve(A, b, solver)
     return zero_empty_rows(X, mask)
 
 
@@ -453,18 +453,26 @@ def _spd_solver_mode() -> str:
     """``lanes`` (batch-on-lanes blocked Cholesky, the TPU default),
     ``cho`` (LAPACK-backed cho_solve — CPU/GPU default), or ``pallas``
     (experimental kernel, ops/als_pallas.py). ``PIO_ALS_SOLVER``
-    overrides."""
+    overrides; an unknown value raises instead of being silently
+    ignored. Resolved ONCE per ``train_als*`` call and passed down as a
+    static jit argument — never read at trace time, so changing the env
+    var between trainings always takes effect (a trace-time read would
+    be baked into the module-level jit caches forever)."""
     import os
 
     forced = os.environ.get("PIO_ALS_SOLVER", "").strip().lower()
-    if forced in ("lanes", "cho", "xla", "pallas"):
+    if forced:
+        if forced not in ("lanes", "cho", "xla", "pallas"):
+            raise ValueError(
+                f"PIO_ALS_SOLVER={forced!r} is not a known solver mode "
+                f"(expected one of: lanes, cho, xla, pallas)")
         return "cho" if forced == "xla" else forced
     import jax
 
     return "lanes" if jax.default_backend() == "tpu" else "cho"
 
 
-def _spd_solve(A, b):
+def _spd_solve(A, b, mode: Optional[str] = None):
     """Batched SPD solve of ``A [B, R, R] x = b [B, R]``.
 
     On TPU, XLA's batched ``cho_factor``/``cho_solve`` is the measured
@@ -474,7 +482,8 @@ def _spd_solve(A, b):
     CPU/GPU keep LAPACK-backed cho_solve."""
     import jax
 
-    mode = _spd_solver_mode()
+    if mode is None:
+        mode = _spd_solver_mode()
     R = b.shape[-1]
     if mode == "pallas":
         from predictionio_tpu.ops import als_pallas
@@ -589,13 +598,15 @@ def spd_solve_lanes(A, b, panel: int = 8):
 
 
 def _solve_side(Y, cols, weights, mask, lam: float, alpha: float,
-                implicit: bool):
+                implicit: bool, solver: Optional[str] = None):
     """One uniform-table alternating half-step (all rows, one batch)."""
-    return _solve_rows(Y, cols, weights, mask, lam, alpha, implicit)
+    return _solve_rows(Y, cols, weights, mask, lam, alpha, implicit,
+                       solver=solver)
 
 
 def _solve_side_blocked(Y, cols, weights, mask, lam: float, alpha: float,
-                        implicit: bool, block: Optional[int]):
+                        implicit: bool, block: Optional[int],
+                        solver: Optional[str] = None):
     """`_solve_side`, optionally over sequential row blocks (lax.map) so
     the [block, L, R] gather — the HBM peak — is bounded regardless of
     row count. Caller guarantees rows % block == 0 (train_als pads)."""
@@ -603,12 +614,13 @@ def _solve_side_blocked(Y, cols, weights, mask, lam: float, alpha: float,
 
     B, L = cols.shape
     if not block or B <= block:
-        return _solve_side(Y, cols, weights, mask, lam, alpha, implicit)
+        return _solve_side(Y, cols, weights, mask, lam, alpha, implicit,
+                           solver)
     nb = B // block
 
     def one(args):
         c, w, m = args
-        return _solve_side(Y, c, w, m, lam, alpha, implicit)
+        return _solve_side(Y, c, w, m, lam, alpha, implicit, solver)
 
     X = jax.lax.map(one, (cols.reshape(nb, block, L),
                           weights.reshape(nb, block, L),
@@ -617,7 +629,8 @@ def _solve_side_blocked(Y, cols, weights, mask, lam: float, alpha: float,
 
 
 def _als_iterations_impl(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m, *, lam,
-                         alpha, implicit, num_iterations, block=None):
+                         alpha, implicit, num_iterations, block=None,
+                         solver=None):
     """Full training loop as one compiled program (lax.scan over
     iterations; no data-dependent Python control flow)."""
     import jax
@@ -625,9 +638,9 @@ def _als_iterations_impl(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m, *, lam,
     def body(carry, _):
         X, Y = carry
         X = _solve_side_blocked(Y, u_cols, u_w, u_m, lam, alpha, implicit,
-                                block)
+                                block, solver)
         Y = _solve_side_blocked(X, i_cols, i_w, i_m, lam, alpha, implicit,
-                                block)
+                                block, solver)
         return (X, Y), None
 
     (X, Y), _ = jax.lax.scan(body, (X, Y), None, length=num_iterations)
@@ -638,7 +651,10 @@ _als_iterations_jit = None
 
 
 def _als_iterations(*args, **kw):
-    """Lazily-jitted wrapper (keeps jax out of storage-only imports)."""
+    """Lazily-jitted wrapper (keeps jax out of storage-only imports).
+    ``solver`` is a STATIC argument: callers resolve the mode at call
+    time, so an env-var change retriggers compilation instead of being
+    baked in at first trace."""
     global _als_iterations_jit
     if _als_iterations_jit is None:
         import jax
@@ -646,13 +662,14 @@ def _als_iterations(*args, **kw):
         _als_iterations_jit = jax.jit(
             _als_iterations_impl,
             static_argnames=("lam", "alpha", "implicit", "num_iterations",
-                             "block"))
+                             "block", "solver"))
     return _als_iterations_jit(*args, **kw)
 
 
 def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
                          alpha: float, implicit: bool,
-                         slot_budget: Optional[int]):
+                         slot_budget: Optional[int],
+                         solver: Optional[str] = None):
     """One alternating half-step over length buckets: each bucket is a
     batched solve at its own ``L`` (one Gram matrix shared by all), and
     the results scatter into the full factor matrix. Rows in no bucket
@@ -685,14 +702,15 @@ def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
             def one(args, _gram=gram):
                 c_, w_, m_ = args
                 return _solve_rows(Y, c_, w_, m_, lam, alpha, implicit,
-                                   _gram)
+                                   _gram, solver)
 
             Xb = jax.lax.map(one, (cols.reshape(nb, block, L),
                                    w.reshape(nb, block, L),
                                    m.reshape(nb, block, L)))
             Xb = Xb.reshape(B + pad, R)
         else:
-            Xb = _solve_rows(Y, cols, w, m, lam, alpha, implicit, gram)
+            Xb = _solve_rows(Y, cols, w, m, lam, alpha, implicit, gram,
+                             solver)
         # pad rows carry the sentinel row_id == n_rows_out -> dropped
         X = X.at[row_ids].set(Xb, mode="drop")
     return X
@@ -700,7 +718,7 @@ def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
 
 def _als_iterations_bucketed_impl(X, Y, u_buckets, i_buckets, *, lam,
                                   alpha, implicit, num_iterations,
-                                  slot_budget):
+                                  slot_budget, solver=None):
     """Bucketed training loop as one compiled program (lax.scan over
     iterations; the per-bucket solves are unrolled in the trace — a
     handful of static shapes, not data-dependent control flow)."""
@@ -711,9 +729,9 @@ def _als_iterations_bucketed_impl(X, Y, u_buckets, i_buckets, *, lam,
     def body(carry, _):
         X, Y = carry
         X = _solve_side_bucketed(Y, u_buckets, n_u, lam, alpha, implicit,
-                                 slot_budget)
+                                 slot_budget, solver)
         Y = _solve_side_bucketed(X, i_buckets, n_i, lam, alpha, implicit,
-                                 slot_budget)
+                                 slot_budget, solver)
         return (X, Y), None
 
     (X, Y), _ = jax.lax.scan(body, (X, Y), None, length=num_iterations)
@@ -731,7 +749,7 @@ def _als_iterations_bucketed(*args, **kw):
         _als_iterations_bucketed_jit = jax.jit(
             _als_iterations_bucketed_impl,
             static_argnames=("lam", "alpha", "implicit", "num_iterations",
-                             "slot_budget"))
+                             "slot_budget", "solver"))
     return _als_iterations_bucketed_jit(*args, **kw)
 
 
@@ -759,7 +777,8 @@ def train_als_bucketed(user_side: BucketedRatings,
         implicit=bool(params.implicit_prefs),
         num_iterations=int(params.num_iterations),
         slot_budget=None if not params.bucket_slot_budget
-        else int(params.bucket_slot_budget))
+        else int(params.bucket_slot_budget),
+        solver=_spd_solver_mode())  # resolved per call, never at trace
     return np.asarray(X), np.asarray(Y)
 
 
@@ -821,7 +840,8 @@ def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
         lam=float(params.lambda_), alpha=float(params.alpha),
         implicit=bool(params.implicit_prefs),
         num_iterations=int(params.num_iterations),
-        block=None if not block else int(block))
+        block=None if not block else int(block),
+        solver=_spd_solver_mode())  # resolved per call, never at trace
     return np.asarray(X)[:n_u], np.asarray(Y)[:n_i]
 
 

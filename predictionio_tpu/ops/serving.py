@@ -298,7 +298,11 @@ class _MicroBatcher:
         with self._cv:
             if self._closed:
                 raise RuntimeError("serving backend is closed")
-            if self._thread is None:
+            if self._thread is None or not self._thread.is_alive():
+                # the dispatcher may have exited through the weakref-dead
+                # idle path (server briefly unreferenced) — a submit on a
+                # dead thread would otherwise block on item.done forever;
+                # restart it, the queue and stats survive
                 self._thread = threading.Thread(
                     target=self._run, daemon=True, name=self.name)
                 self._thread.start()

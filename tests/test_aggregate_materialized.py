@@ -1,0 +1,390 @@
+"""Differential suite: MATERIALIZED aggregate_properties must match the
+replay fold bit-for-bit on randomized ``$set/$unset/$delete`` streams.
+
+The materialized path (write-through entity_props in sqlite, in-memory
+states, jsonlfs watermark snapshot, server-side aggregation over the
+resthttp wire) serves every template's unbounded training read; the
+replay fold over ``find`` is the reference semantics
+(LEvents.scala:191-214). Any divergence — out-of-order arrivals,
+re-``$set`` after ``$delete``, event-id upserts, deletes, cutoff
+cleanups, time-bounded fallbacks — is a correctness bug, so each
+scenario compares the two paths exactly (PropertyMap equality covers
+fields AND first/lastUpdated)."""
+
+import datetime as dt
+import random
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+
+UTC = dt.timezone.utc
+APP = 1
+
+
+def t(i):
+    return dt.datetime(2021, 6, 1, 0, 0, 0, tzinfo=UTC) \
+        + dt.timedelta(seconds=int(i))
+
+
+@pytest.fixture(params=["memory", "sqlite", "jsonlfs", "resthttp"])
+def levents(request, tmp_path):
+    if request.param == "memory":
+        from predictionio_tpu.data.storage.memory import MemLEvents
+        yield MemLEvents({})
+        return
+    if request.param == "sqlite":
+        from predictionio_tpu.data.storage.sqlite import (
+            SqliteClient, SqliteLEvents)
+        le = SqliteLEvents({"path": str(tmp_path / "agg.db")})
+        yield le
+        SqliteClient.shutdown_all()
+        return
+    if request.param == "jsonlfs":
+        from predictionio_tpu.data.storage.jsonlfs import JsonlFsLEvents
+        # tiny partitions: snapshots must survive partition rolling
+        yield JsonlFsLEvents({"path": str(tmp_path / "ev"),
+                              "part_max_events": 7})
+        return
+    # resthttp: a live jsonlfs-backed event server, aggregation answered
+    # server-side from ITS materialized state over /storage/aggregate.json
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.api.event_server import (
+        EventServer, EventServerConfig,
+    )
+    from predictionio_tpu.data.storage.resthttp import RestLEvents
+
+    reg = storage_mod.StorageRegistry(storage_mod.StorageConfig(
+        sources={"EV": {"type": "jsonlfs",
+                        "path": str(tmp_path / "server_ev"),
+                        "part_max_events": 7},
+                 "META": {"type": "memory"}},
+        repositories={"EVENTDATA": "EV", "METADATA": "META",
+                      "MODELDATA": "META"}))
+    server = EventServer(
+        EventServerConfig(ip="127.0.0.1", port=0, service_key="agg-secret"),
+        reg=reg).start()
+    host, port = server.address
+    yield RestLEvents({"url": f"http://{host}:{port}",
+                       "service_key": "agg-secret"})
+    server.stop()
+
+
+def random_stream(rng: random.Random, n: int, n_entities: int,
+                  etypes=("user", "item")):
+    """A randomized special-event stream with OUT-OF-ORDER event times,
+    tombstoning deletes and interleaved non-special noise."""
+    events = []
+    for i in range(n):
+        etype = rng.choice(etypes)
+        eid = f"e{rng.randrange(n_entities)}"
+        # times jump backwards and forwards and collide across entities
+        when = t(rng.randrange(n * 2))
+        roll = rng.random()
+        if roll < 0.5:
+            events.append(Event(
+                event="$set", entity_type=etype, entity_id=eid,
+                properties={rng.choice("abcd"): rng.randrange(100),
+                            "n": i},
+                event_time=when))
+        elif roll < 0.7:
+            events.append(Event(
+                event="$unset", entity_type=etype, entity_id=eid,
+                properties={rng.choice("abcd"): 0}, event_time=when))
+        elif roll < 0.8:
+            events.append(Event(
+                event="$delete", entity_type=etype, entity_id=eid,
+                event_time=when))
+        else:  # non-special noise: must not touch aggregation state
+            events.append(Event(
+                event="rate", entity_type=etype, entity_id=eid,
+                target_entity_type="item", target_entity_id="i1",
+                properties={"rating": rng.randrange(1, 6)},
+                event_time=when))
+    return events
+
+
+def assert_paths_agree(le, etypes=("user", "item"), **bounds):
+    for etype in etypes:
+        got = le.aggregate_properties(APP, etype, **bounds)
+        want = le.aggregate_properties_replay(APP, etype, **bounds)
+        assert got == want, (
+            f"{etype} {bounds}: materialized != replay\n"
+            f"got:  { {k: (v.fields, v.first_updated, v.last_updated) for k, v in sorted(got.items())} }\n"
+            f"want: { {k: (v.fields, v.first_updated, v.last_updated) for k, v in sorted(want.items())} }")
+        assert all(isinstance(k, str) for k in got)
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_randomized_stream(self, levents, seed):
+        rng = random.Random(seed)
+        le = levents
+        le.init(APP)
+        stream = random_stream(rng, 120, n_entities=15)
+        # mixed single inserts and batches, reads interleaved so the
+        # materialized state is exercised mid-stream, not just at the end
+        pos = 0
+        while pos < len(stream):
+            k = rng.choice([1, 1, 3, 7])
+            chunk = stream[pos:pos + k]
+            if len(chunk) == 1:
+                le.insert(chunk[0], APP)
+            else:
+                le.insert_batch(chunk, APP)
+            pos += k
+            if rng.random() < 0.3:
+                assert_paths_agree(le)
+        assert_paths_agree(le)
+
+    def test_reinsert_after_delete_keeps_first_updated(self, levents):
+        le = levents
+        le.init(APP)
+        le.insert(Event(event="$set", entity_type="user", entity_id="u",
+                        properties={"a": 1}, event_time=t(1)), APP)
+        le.insert(Event(event="$delete", entity_type="user", entity_id="u",
+                        event_time=t(2)), APP)
+        assert le.aggregate_properties(APP, "user") == {}
+        le.insert(Event(event="$set", entity_type="user", entity_id="u",
+                        properties={"b": 2}, event_time=t(3)), APP)
+        got = le.aggregate_properties(APP, "user")
+        assert got["u"].fields == {"b": 2}
+        # the tombstone preserved the pre-delete history's firstUpdated
+        assert got["u"].first_updated == t(1)
+        assert got["u"].last_updated == t(3)
+        assert_paths_agree(le)
+
+    def test_out_of_order_arrival(self, levents):
+        le = levents
+        le.init(APP)
+        le.insert(Event(event="$set", entity_type="user", entity_id="u",
+                        properties={"a": 1}, event_time=t(10)), APP)
+        assert_paths_agree(le)
+        # arrives LATER but happened EARLIER: replay folds it first, so
+        # its value of "a" must lose to the t(10) $set
+        le.insert(Event(event="$set", entity_type="user", entity_id="u",
+                        properties={"a": 99, "old": True},
+                        event_time=t(5)), APP)
+        got = le.aggregate_properties(APP, "user")
+        assert got["u"].fields == {"a": 1, "old": True}
+        assert got["u"].first_updated == t(5)
+        assert_paths_agree(le)
+        # an out-of-order $delete rewrites history the same way
+        le.insert(Event(event="$delete", entity_type="user", entity_id="u",
+                        event_time=t(7)), APP)
+        got = le.aggregate_properties(APP, "user")
+        assert got["u"].fields == {"a": 1}
+        assert_paths_agree(le)
+
+    def test_event_delete_repairs_state(self, levents):
+        le = levents
+        le.init(APP)
+        ids = [le.insert(Event(event="$set", entity_type="user",
+                               entity_id="u", properties={"k": i},
+                               event_time=t(i)), APP)
+               for i in range(4)]
+        assert le.aggregate_properties(APP, "user")["u"].fields == {"k": 3}
+        le.delete(ids[3], APP)
+        got = le.aggregate_properties(APP, "user")
+        assert got["u"].fields == {"k": 2}
+        assert got["u"].last_updated == t(2)
+        assert_paths_agree(le)
+
+    def test_time_bounded_calls_fall_back_to_replay(self, levents):
+        rng = random.Random(7)
+        le = levents
+        le.init(APP)
+        le.insert_batch(random_stream(rng, 60, n_entities=8), APP)
+        assert_paths_agree(le)  # warm the materialized state
+        # bounded queries must ignore it and replay the window exactly
+        for bounds in ({"start_time": t(30)}, {"until_time": t(60)},
+                       {"start_time": t(20), "until_time": t(90)}):
+            assert_paths_agree(le, **bounds)
+
+    def test_delete_until_then_continue(self, levents):
+        rng = random.Random(11)
+        le = levents
+        le.init(APP)
+        le.insert_batch(random_stream(rng, 50, n_entities=6), APP)
+        assert_paths_agree(le)  # materialize before the cutoff wipe
+        le.delete_until(APP, t(40))
+        assert_paths_agree(le)
+        # writes after the invalidation keep the paths in lockstep
+        le.insert_batch(random_stream(rng, 30, n_entities=6), APP)
+        assert_paths_agree(le)
+
+    def test_channel_isolation(self, levents):
+        le = levents
+        le.init(APP)
+        le.init(APP, 3)
+        le.insert(Event(event="$set", entity_type="user", entity_id="u",
+                        properties={"main": 1}, event_time=t(1)), APP)
+        le.insert(Event(event="$set", entity_type="user", entity_id="u",
+                        properties={"chan": 2}, event_time=t(1)), APP, 3)
+        assert le.aggregate_properties(APP, "user")["u"].fields == {"main": 1}
+        assert le.aggregate_properties(
+            APP, "user", channel_id=3)["u"].fields == {"chan": 2}
+        assert_paths_agree(le)
+        assert_paths_agree(le, channel_id=3)
+
+    def test_required_filter(self, levents):
+        le = levents
+        le.init(APP)
+        le.insert(Event(event="$set", entity_type="user", entity_id="u1",
+                        properties={"a": 1, "b": 2}, event_time=t(1)), APP)
+        le.insert(Event(event="$set", entity_type="user", entity_id="u2",
+                        properties={"b": 3}, event_time=t(1)), APP)
+        assert set(le.aggregate_properties(APP, "user",
+                                           required=["a"])) == {"u1"}
+        assert set(le.aggregate_properties(APP, "user",
+                                           required=["b"])) == {"u1", "u2"}
+
+
+class TestSqliteSpecifics:
+    """Paths only the sqlite write-through layer has: lazy backfill of a
+    pre-existing DB and event-id upserts."""
+
+    def _mk(self, tmp_path, name="pre.db"):
+        from predictionio_tpu.data.storage.sqlite import SqliteLEvents
+        return SqliteLEvents({"path": str(tmp_path / name)})
+
+    def test_lazy_backfill_of_preexisting_events(self, tmp_path):
+        from predictionio_tpu.data.storage.sqlite import SqliteClient
+        le = self._mk(tmp_path)
+        try:
+            rng = random.Random(3)
+            # events inserted BEFORE any read materialized the scope
+            le.insert_batch(random_stream(rng, 40, n_entities=5), APP)
+            assert_paths_agree(le)
+            # and write-through keeps it fresh afterwards
+            le.insert_batch(random_stream(rng, 40, n_entities=5), APP)
+            assert_paths_agree(le)
+        finally:
+            SqliteClient.shutdown_all()
+
+    def test_duplicate_id_within_one_batch(self, tmp_path):
+        from predictionio_tpu.data.storage.sqlite import SqliteClient
+        le = self._mk(tmp_path)
+        try:
+            le.aggregate_properties(APP, "user")  # materialize the scope
+            # same preset id twice in ONE batch — only the second row
+            # survives the upsert; neither may double-fold
+            le.insert_batch([
+                Event(event="$set", entity_type="user", entity_id="u",
+                      properties={"a": 1}, event_time=t(1),
+                      event_id="dup"),
+                Event(event="$set", entity_type="user", entity_id="v",
+                      properties={"b": 2}, event_time=t(2),
+                      event_id="dup"),
+            ], APP)
+            got = le.aggregate_properties(APP, "user")
+            assert set(got) == {"v"} and got["v"].fields == {"b": 2}
+            assert_paths_agree(le)
+        finally:
+            SqliteClient.shutdown_all()
+
+    def test_raw_batch_replacing_special_event_refolds(self, tmp_path):
+        from predictionio_tpu.data.storage.sqlite import SqliteClient
+        le = self._mk(tmp_path)
+        try:
+            le.insert(Event(event="$set", entity_type="user",
+                            entity_id="u", properties={"p": 1},
+                            event_time=t(1), event_id="raw1"), APP)
+            assert le.aggregate_properties(
+                APP, "user")["u"].fields == {"p": 1}
+            # the raw fast lane replaces the $set with a NON-special
+            # event: u's materialized state must vanish with it
+            le.insert_raw_batch(
+                [("raw1", "view", "user", "w", None, None, "{}",
+                  t(2).timestamp(), "[]", None, t(2).timestamp())], APP)
+            assert le.aggregate_properties(APP, "user") == {}
+            assert_paths_agree(le)
+        finally:
+            SqliteClient.shutdown_all()
+
+    def test_event_id_upsert_refolds(self, tmp_path):
+        from predictionio_tpu.data.storage.sqlite import SqliteClient
+        le = self._mk(tmp_path)
+        try:
+            le.insert(Event(event="$set", entity_type="user",
+                            entity_id="u", properties={"a": 1},
+                            event_time=t(1), event_id="fixed"), APP)
+            assert le.aggregate_properties(
+                APP, "user")["u"].fields == {"a": 1}
+            # same event_id, different payload AND entity: the old
+            # row's contribution must vanish from BOTH entities
+            le.insert(Event(event="$set", entity_type="user",
+                            entity_id="v", properties={"b": 2},
+                            event_time=t(2), event_id="fixed"), APP)
+            got = le.aggregate_properties(APP, "user")
+            assert set(got) == {"v"}
+            assert got["v"].fields == {"b": 2}
+            assert_paths_agree(le)
+        finally:
+            SqliteClient.shutdown_all()
+
+
+class TestJsonlfsSnapshot:
+    """The watermark must make repeat reads O(delta): the snapshot file
+    persists, and a second reader instance picks it up from disk."""
+
+    def test_snapshot_persists_and_reloads(self, tmp_path):
+        import os
+
+        from predictionio_tpu.data.storage.jsonlfs import (
+            SNAPSHOT_NAME, JsonlFsLEvents)
+
+        cfg = {"path": str(tmp_path / "ev"), "part_max_events": 5}
+        le = JsonlFsLEvents(cfg)
+        le.init(APP)
+        rng = random.Random(5)
+        le.insert_batch(random_stream(rng, 30, n_entities=4), APP)
+        first = le.aggregate_properties(APP, "user")
+        snap = os.path.join(le._dir(APP, None), SNAPSHOT_NAME)
+        assert os.path.exists(snap)
+        # a FRESH instance (new process analog) must serve the same
+        # state from the snapshot + empty delta
+        le2 = JsonlFsLEvents(cfg)
+        assert le2.aggregate_properties(APP, "user") == first
+        # appends past the watermark fold in as delta
+        le2.insert(Event(event="$set", entity_type="user", entity_id="zz",
+                         properties={"fresh": 1}, event_time=t(999)), APP)
+        assert_paths_agree(le2)
+
+    def test_escaped_event_name_in_raw_line(self, tmp_path):
+        """Raw client lines arrive verbatim; a $set spelled with the
+        JSON escape \\u0024 must still reach the snapshot fold."""
+        from predictionio_tpu.data.storage.jsonlfs import JsonlFsLEvents
+
+        le = JsonlFsLEvents({"path": str(tmp_path / "ev"),
+                             "part_max_events": 5})
+        le.init(APP)
+        le.append_raw_lines(
+            ['{"event":"\\u0024set","entityType":"user","entityId":"esc",'
+             '"properties":{"a":1},"eventTime":"2021-06-01T00:00:01+00:00",'
+             '"creationTime":"2021-06-01T00:00:01+00:00","eventId":"e1"}'],
+            APP)
+        got = le.aggregate_properties(APP, "user")
+        assert got["esc"].fields == {"a": 1}
+        assert_paths_agree(le)
+
+    def test_rewrite_invalidates_snapshot(self, tmp_path):
+        import os
+
+        from predictionio_tpu.data.storage.jsonlfs import (
+            SNAPSHOT_NAME, JsonlFsLEvents)
+
+        le = JsonlFsLEvents({"path": str(tmp_path / "ev"),
+                             "part_max_events": 5})
+        le.init(APP)
+        ids = [le.insert(Event(event="$set", entity_type="user",
+                               entity_id="u", properties={"k": i},
+                               event_time=t(i)), APP) for i in range(6)]
+        le.aggregate_properties(APP, "user")
+        snap = os.path.join(le._dir(APP, None), SNAPSHOT_NAME)
+        assert os.path.exists(snap)
+        le.delete(ids[5], APP)  # partition rewrite
+        assert not os.path.exists(snap)
+        got = le.aggregate_properties(APP, "user")
+        assert got["u"].fields == {"k": 4}
+        assert_paths_agree(le)

@@ -319,12 +319,9 @@ class TestExportImport:
                      str(z)]) == 1
         assert "not a readable columnar" in capsys.readouterr().err
 
-    def test_columnar_roundtrip_faster_and_smaller_at_scale(
-            self, sqlite_storage, tmp_path, capsys):
-        """The point of the format (EventsToFile.scala:35,94 parquet
-        default): at 100k events the columnar round trip beats jsonl on
-        wall-clock and the file is an order of magnitude smaller
-        (measured at 1M: 30.4s vs 48.8s, 7MB vs 243MB)."""
+    def _columnar_roundtrip(self, tmp_path):
+        """100k-event jsonl vs columnar export/import round trip; returns
+        (t_jsonl, t_col, jsonl_path, npz_path, N)."""
         import time
 
         import numpy as np
@@ -358,17 +355,37 @@ class TestExportImport:
         assert main(["import", "--app-name", "impc", "--input",
                      npz]) == 0
         t_col = time.perf_counter() - t0
+        return t_jsonl, t_col, jl, npz, N
+
+    def test_columnar_roundtrip_smaller_at_scale(
+            self, sqlite_storage, tmp_path, capsys):
+        """The point of the format (EventsToFile.scala:35,94 parquet
+        default): at 100k events the columnar file is an order of
+        magnitude smaller than jsonl and the round trip is lossless
+        (measured at 1M: 7MB vs 243MB). The wall-clock ratio is a
+        separate perf-marked test — timing under CI load is noise, the
+        file size is the deterministic hard check."""
+        _, _, jl, npz, N = self._columnar_roundtrip(tmp_path)
 
         import os as _os
         assert _os.path.getsize(npz) < _os.path.getsize(jl) / 10
-        # generous CI-noise margin; the format must never be
-        # catastrophically slower (measured 1.6x faster at 1M)
-        assert t_col < t_jsonl * 1.5, (t_col, t_jsonl)
+        le = storage.get_levents()
         aj = storage.get_metadata_apps().get_by_name("impj")
         ac = storage.get_metadata_apps().get_by_name("impc")
         nj = sum(1 for _ in le.find(aj.id, limit=-1))
         nc = sum(1 for _ in le.find(ac.id, limit=-1))
         assert nj == nc == N
+
+    @pytest.mark.perf
+    @pytest.mark.slow
+    def test_columnar_roundtrip_wallclock_ratio(
+            self, sqlite_storage, tmp_path, capsys):
+        """Perf-only (run with ``-m perf``): the columnar round trip must
+        not be catastrophically slower than jsonl (measured 1.6x FASTER
+        at 1M; 1.5x is a generous noise margin). Excluded from tier-1 —
+        wall-clock ratios flake under parallel CI load."""
+        t_jsonl, t_col, _, _, _ = self._columnar_roundtrip(tmp_path)
+        assert t_col < t_jsonl * 1.5, (t_col, t_jsonl)
 
     def test_bad_format_flag(self, mem_storage, tmp_path, capsys):
         main(["app", "new", "fmtapp"])

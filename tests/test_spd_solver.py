@@ -83,12 +83,10 @@ class TestSolverSwapPreservesTraining:
         params = ALSParams(rank=8, num_iterations=2, seed=2)
 
         def train_both(flavor):
+            # solver mode is resolved per train_als* call and passed as a
+            # static jit arg — flipping the env var between trainings
+            # must take effect WITHOUT clearing any jit cache
             monkeypatch.setenv("PIO_ALS_SOLVER", flavor)
-            # solver mode is read at trace time; new (N, L) shapes per
-            # flavor are NOT guaranteed, so clear the jit caches
-            import predictionio_tpu.ops.als as m
-            m._als_iterations_jit = None
-            m._als_iterations_bucketed_jit = None
             Xu, Yu = train_als(pad_ratings(rows, cols, vals, 60, 40),
                                pad_ratings(cols, rows, vals, 40, 60),
                                params)
@@ -100,8 +98,13 @@ class TestSolverSwapPreservesTraining:
         cho = train_both("cho")
         lanes = train_both("lanes")
         monkeypatch.delenv("PIO_ALS_SOLVER")
-        import predictionio_tpu.ops.als as m
-        m._als_iterations_jit = None
-        m._als_iterations_bucketed_jit = None
         for got, want in zip(lanes, cho):
             np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-4)
+
+    def test_unknown_solver_mode_fails_loudly(self, monkeypatch):
+        """A typo'd PIO_ALS_SOLVER must raise, not silently fall back."""
+        from predictionio_tpu.ops.als import _spd_solver_mode
+
+        monkeypatch.setenv("PIO_ALS_SOLVER", "turbo")
+        with pytest.raises(ValueError, match="PIO_ALS_SOLVER"):
+            _spd_solver_mode()
