@@ -35,6 +35,19 @@ from predictionio_tpu.core.base import (
     run_sanity_check,
 )
 from predictionio_tpu.core.context import ComputeContext
+from predictionio_tpu.utils import metrics
+from predictionio_tpu.utils.tracing import span
+
+
+def _stage_span(stage: str):
+    """One DASE stage: an INFO span (request-id tagged) feeding the
+    pio_train_stage_seconds{stage=...} histogram — the per-stage
+    attribution the reference delegates to the Spark UI."""
+    import logging
+
+    return span(f"dase.{stage}", level=logging.INFO,
+                histogram=metrics.TRAIN_STAGE_LATENCY.child(stage=stage)
+                if metrics.REGISTRY.enabled else None)
 
 
 class EngineConfigError(ValueError):
@@ -380,19 +393,22 @@ def train_pipeline(ctx: ComputeContext, data_source: BaseDataSource,
     """The train dataflow (Engine.scala:622-709): read -> sanity ->
     [stop-after-read] -> prepare -> sanity -> [stop-after-prepare] ->
     train each algorithm -> sanity each model."""
-    td = data_source.read_training_base(ctx)
+    with _stage_span("read"):
+        td = data_source.read_training_base(ctx)
     if not params.skip_sanity_check:
         run_sanity_check(td)
     if params.stop_after_read:
         raise StopAfterReadInterruption(
             "Stopping after read (stop_after_read)")
-    pd = preparator.prepare_base(ctx, td)
+    with _stage_span("prepare"):
+        pd = preparator.prepare_base(ctx, td)
     if not params.skip_sanity_check:
         run_sanity_check(pd)
     if params.stop_after_prepare:
         raise StopAfterPrepareInterruption(
             "Stopping after prepare (stop_after_prepare)")
-    models = [algo.train_base(ctx, pd) for algo in algorithms]
+    with _stage_span("train"):
+        models = [algo.train_base(ctx, pd) for algo in algorithms]
     if not params.skip_sanity_check:
         for m in models:
             run_sanity_check(m)
@@ -408,6 +424,12 @@ def eval_pipeline(ctx: ComputeContext, data_source: BaseDataSource,
     train every algorithm, supplement queries, batch-predict per algorithm,
     regroup per query in algorithm order, and serve with the ORIGINAL
     (un-supplemented) query — exactly the reference's join semantics."""
+    with _stage_span("eval"):
+        return _eval_pipeline_body(ctx, data_source, preparator,
+                                   algorithms, serving)
+
+
+def _eval_pipeline_body(ctx, data_source, preparator, algorithms, serving):
     out: List[Tuple[Any, List[Tuple[Any, Any, Any]]]] = []
     for td, eval_info, qa_pairs in data_source.read_eval_base(ctx):
         indexed_qas: List[Tuple[int, Tuple[Any, Any]]] = list(

@@ -40,6 +40,10 @@ from predictionio_tpu.data.event import (
     validate_event,
 )
 from predictionio_tpu.data.storage.base import UNSET
+from predictionio_tpu.utils import metrics
+from predictionio_tpu.utils.http_instrumentation import (
+    InstrumentedHandlerMixin,
+)
 
 logger = logging.getLogger("pio.eventserver")
 
@@ -97,6 +101,10 @@ class EventServer:
         self.access_keys_client = self.registry.get_metadata_access_keys()
         self.channels_client = self.registry.get_metadata_channels()
         self.stats_keeper = StatsKeeper() if config.stats else None
+        # client-chosen event names are a label value: cap the distinct
+        # series one SERVER will ever mint (registry series never evict);
+        # per-instance so one exhausted server cannot poison another
+        self._event_label = metrics.BoundedLabel(cap=100)
         self.plugin_context = plugin_context or EventServerPluginContext()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -192,6 +200,11 @@ class EventServer:
 
     # -- route logic -------------------------------------------------------
     def _bookkeep(self, app_id: int, status: int, event: Event) -> None:
+        # per-event-type ingest counters are always on (registry-gated),
+        # unlike the reference's opt-in --stats windows
+        metrics.INGEST_EVENTS.inc(app_id=str(app_id),
+                                  event=self._event_label(event.event),
+                                  status=str(status))
         if self.stats_keeper is not None:
             self.stats_keeper.bookkeeping(app_id, status, event)
 
@@ -305,7 +318,18 @@ class EventServer:
         if self.stats_keeper is None:
             return 404, {"message": "To see stats, launch Event Server with "
                                     "--stats argument."}
-        return 200, self.stats_keeper.get(auth.app_id)
+        payload = self.stats_keeper.get(auth.app_id)
+        # richer than the reference shape: the process-wide registry
+        # snapshot rides along. The caller authed for ONE app, so
+        # app-labeled series are filtered to it — the reference's
+        # /stats.json was app-scoped and this view must not widen it
+        snap = metrics.registry().snapshot()
+        for fam in snap.values():
+            fam["series"] = [
+                s for s in fam["series"]
+                if s["labels"].get("app_id") in (None, str(auth.app_id))]
+        payload["metrics"] = {k: v for k, v in snap.items() if v["series"]}
+        return 200, payload
 
     def post_webhooks(self, auth: AuthData, name: str, form: bool,
                       body: bytes,
@@ -461,11 +485,15 @@ class EventServer:
         unfiltered = not any(k in query for k in self._STORAGE_FILTER_KEYS)
         le = self.event_client
         from predictionio_tpu.data.storage.jsonlfs import JsonlFsLEvents
+        from predictionio_tpu.data.storage.observed import unwrap
 
-        if unfiltered and isinstance(le, JsonlFsLEvents):
-            d = le._dir(app_id, ch)
+        # the fast lane needs the concrete backend behind the metrics
+        # wrapper (partition files ARE the wire format)
+        raw = unwrap(le)
+        if unfiltered and isinstance(raw, JsonlFsLEvents):
+            d = raw._dir(app_id, ch)
             def raw_parts():
-                for part in le._parts(d):
+                for part in raw._parts(d):
                     with open(part, "rb") as f:
                         while True:
                             chunk = f.read(1 << 22)
@@ -558,23 +586,16 @@ def _parse_event(body: bytes) -> Event:
         raise _HttpError(400, {"message": str(e)})
 
 
-class _EventHandler(BaseHTTPRequestHandler):
+class _EventHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
     """Request → route dispatch. One instance per request (threaded)."""
 
     event_server: EventServer  # injected by EventServer.start
     protocol_version = "HTTP/1.1"
+    metrics_server_label = "event"
 
     # -- plumbing ----------------------------------------------------------
     def log_message(self, fmt, *args):  # route through logging, not stderr
         logger.debug("%s - %s", self.address_string(), fmt % args)
-
-    def _respond(self, status: int, payload: Any) -> None:
-        body = json.dumps(payload).encode("utf-8")
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json; charset=UTF-8")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
 
     def _body(self) -> bytes:
         return self._request_body
@@ -585,9 +606,13 @@ class _EventHandler(BaseHTTPRequestHandler):
         connection (``_stream_started`` tells ``_dispatch`` a second
         response is impossible) — the client sees a truncated chunked
         stream and raises, never silently-short data."""
+        self._status_sent = status
         self.send_response(status)
         self.send_header("Content-Type", "application/x-jsonlines")
         self.send_header("Transfer-Encoding", "chunked")
+        rid = getattr(self, "_request_id", None)
+        if rid:
+            self.send_header("X-Request-ID", rid)
         self.end_headers()
         self._stream_started = True
         for c in chunks:
@@ -598,10 +623,33 @@ class _EventHandler(BaseHTTPRequestHandler):
             self.wfile.write(b"\r\n")
         self.wfile.write(b"0\r\n\r\n")
 
+    # route patterns for metric labels: bounded cardinality, never raw
+    # paths (an id or webhook name must not mint a new series)
+    def _route_label(self, path: str) -> str:
+        if path in ("/", "/metrics", "/stats.json", "/events.json",
+                    "/batch/events.json", "/plugins.json",
+                    "/storage/events.jsonl", "/storage/init.json",
+                    "/storage/remove.json", "/storage/delete_until.json",
+                    "/storage/aggregate.json"):
+            return path
+        if path.startswith("/storage/events/"):
+            return "/storage/events/<id>.json"
+        if path.startswith("/events/"):
+            return "/events/<id>.json"
+        if path.startswith("/webhooks/"):
+            return "/webhooks/<name>"
+        if path.startswith("/plugins/"):
+            return "/plugins/<type>/<name>"
+        return "<other>"
+
     def _dispatch(self, method: str) -> None:
-        srv = self.event_server
         parsed = urllib.parse.urlsplit(self.path)
         path = parsed.path.rstrip("/") or "/"
+        self._dispatch_instrumented(
+            method, path, lambda: self._handle(method, path, parsed))
+
+    def _handle(self, method: str, path: str, parsed) -> None:
+        srv = self.event_server
         query = urllib.parse.parse_qs(parsed.query)
         # Drain the request body up-front: every exit path (401, 404, ...)
         # must leave rfile at a message boundary or HTTP/1.1 keep-alive
@@ -615,6 +663,14 @@ class _EventHandler(BaseHTTPRequestHandler):
         try:
             if path == "/" and method == "GET":
                 self._respond(200, {"status": "alive"})
+                return
+            if path == "/metrics" and method == "GET":
+                # Prometheus scrape endpoint: unauthenticated like GET /.
+                # It is an OPERATOR surface — it carries cross-app
+                # operational counters (event-type names, volumes), so
+                # bind it to scrape-network interfaces, not the public
+                # internet (README "Observability")
+                self._respond_prometheus()
                 return
             if path == "/plugins.json" and method == "GET":
                 self._respond(200, srv.plugin_context.describe())

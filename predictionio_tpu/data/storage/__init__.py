@@ -198,6 +198,14 @@ class StorageRegistry:
                     inst = _load(spec)(cfg)
                     if isinstance(inst, base.LEvents) and kind == "PEvents":
                         inst = base.LEventsBackedPEvents(inst)
+                if kind == "LEvents" and isinstance(inst, base.LEvents):
+                    # every event-store DAO the registry hands out reports
+                    # pio_storage_op_* metrics; code needing the concrete
+                    # backend type unwraps via observed.unwrap()
+                    from predictionio_tpu.data.storage.observed import (
+                        DAOMetricsWrapper,
+                    )
+                    inst = DAOMetricsWrapper(inst, backend=cfg["type"])
                 self._cache[key] = inst
             return self._cache[key]
 

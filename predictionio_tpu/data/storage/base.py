@@ -27,6 +27,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 from predictionio_tpu.data.aggregator import aggregate_properties
 from predictionio_tpu.data.datamap import PropertyMap
 from predictionio_tpu.data.event import Event
+from predictionio_tpu.utils import metrics
 
 # Sentinel distinguishing "no filter" from "filter for None"
 # (reference models this as Option[Option[String]], LEvents.scala:137-150).
@@ -39,6 +40,10 @@ class StorageError(RuntimeError):
 
 class LEvents(abc.ABC):
     """Event store DAO scoped by (app_id, channel_id)."""
+
+    # label value for this backend's storage/aggregation metrics;
+    # concrete backends override (memory/sqlite/jsonlfs/resthttp)
+    metrics_backend = "unknown"
 
     @abc.abstractmethod
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
@@ -161,12 +166,21 @@ class LEvents(abc.ABC):
         issues — is served from materialized state when the backend
         keeps it (O(current entities) instead of O(event history)); any
         ``start_time``/``until_time`` bound falls back to the replay
-        fold so time-travel semantics stay exact."""
+        fold so time-travel semantics stay exact. Every read is
+        accounted in the metrics registry: a materialized hit, a
+        ``bounded`` replay (time-travel query) or a ``fallback`` replay
+        (backend keeps no state / its state was unreachable)."""
         if start_time is None and until_time is None:
             result = self.materialized_aggregate(app_id, entity_type,
                                                  channel_id)
             if result is not None:
+                metrics.AGGREGATE_HITS.inc(backend=self.metrics_backend)
                 return _apply_required(result, required)
+            metrics.AGGREGATE_REPLAYS.inc(backend=self.metrics_backend,
+                                          reason="fallback")
+        else:
+            metrics.AGGREGATE_REPLAYS.inc(backend=self.metrics_backend,
+                                          reason="bounded")
         return self.aggregate_properties_replay(
             app_id, entity_type, channel_id=channel_id,
             start_time=start_time, until_time=until_time, required=required)

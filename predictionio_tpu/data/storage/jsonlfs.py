@@ -57,6 +57,7 @@ from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import UNSET
 from predictionio_tpu.data.storage.localfs import atomic_write_bytes
 from predictionio_tpu.data.storage.memory import match_event
+from predictionio_tpu.utils import metrics
 
 DEFAULT_PART_MAX_EVENTS = 500_000
 SNAPSHOT_NAME = "props_snapshot.json"
@@ -79,6 +80,8 @@ def _parse_event_line(raw: str, source: str) -> Optional[Event]:
 
 class JsonlFsLEvents(base.LEvents):
     """LEvents over partitioned JSONL files (one dir per app/channel)."""
+
+    metrics_backend = "jsonlfs"
 
     def __init__(self, config: Optional[dict] = None):
         cfg = config or {}
@@ -393,6 +396,7 @@ class JsonlFsLEvents(base.LEvents):
             self._snapshots.pop(d, None)
         try:
             os.unlink(os.path.join(d, SNAPSHOT_NAME))
+            metrics.AGGREGATE_SCOPE_DROPS.inc(backend=self.metrics_backend)
         except FileNotFoundError:
             pass
 
@@ -465,9 +469,15 @@ class JsonlFsLEvents(base.LEvents):
                     # a rewrite slipped past invalidation (another
                     # process): offsets are meaningless, refold everything
                     snap = {"watermark": {}, "states": {}}
+                fresh = not snap["watermark"]
                 lines, new_mark = self._delta_lines(d, parts,
                                                     snap["watermark"])
                 if lines or new_mark != snap["watermark"]:
+                    if fresh:
+                        # folding the whole store, not a delta — the
+                        # jsonlfs analog of the sqlite scope backfill
+                        metrics.AGGREGATE_BACKFILLS.inc(
+                            backend=self.metrics_backend)
                     delta: List[Event] = []
                     for ln in lines:
                         # cheap prefilter: a special event's JSON must

@@ -58,6 +58,8 @@ def match_event(
 
 
 class MemLEvents(base.LEvents):
+    metrics_backend = "memory"
+
     def __init__(self, config: Optional[dict] = None):
         # (app_id, channel_id) -> {event_id: Event}; insertion order kept
         self._tables: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
@@ -78,8 +80,13 @@ class MemLEvents(base.LEvents):
         return True
 
     def remove(self, app_id, channel_id=None) -> bool:
+        from predictionio_tpu.utils import metrics
+
         with self._lock:
-            self._props.pop(self._key(app_id, channel_id), None)
+            if self._props.pop(self._key(app_id, channel_id), None) \
+                    is not None:
+                metrics.AGGREGATE_SCOPE_DROPS.inc(
+                    backend=self.metrics_backend)
             return self._tables.pop(self._key(app_id, channel_id), None) is not None
 
     def close(self) -> None:

@@ -1,0 +1,184 @@
+"""DAO instrumentation: per-backend / per-op latency + error counters.
+
+The storage registry wraps every event-store ``LEvents`` DAO it hands
+out in :class:`DAOMetricsWrapper`, so all four event backends (memory,
+sqlite, jsonlfs, resthttp) report ``pio_storage_op_seconds{backend,op}``
+and ``pio_storage_op_errors_total{backend,op,error}`` without any code
+in the backends themselves. Slow-path attribution rides the
+request-scoped tracing contextvar: with debug logging on, every storage
+op logs a record tagged with the ``X-Request-ID`` of the HTTP request
+that caused it.
+
+The wrapper is transparent: unknown attributes delegate to the wrapped
+DAO (the jsonlfs raw-partition fast lane reads ``_dir``/``_parts``
+through it), and code that needs the concrete backend type unwraps via
+``unwrap()`` / the ``_wrapped`` attribute — ``isinstance`` on the
+wrapper itself only sees :class:`~predictionio_tpu.data.storage.base.
+LEvents`.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.utils import metrics
+from predictionio_tpu.utils.tracing import current_request_id
+
+logger = logging.getLogger("pio.storage.ops")
+
+# passthrough attributes that still deserve timing (optional per backend)
+_EXTRA_TIMED_OPS = ("append_raw_lines",)
+
+
+def unwrap(dao: Any) -> Any:
+    """The concrete DAO behind a (possibly) wrapped one."""
+    return getattr(dao, "_wrapped", dao)
+
+
+class _TimedIterator:
+    """Wraps a lazy ``find`` result so the recorded duration covers the
+    scan, not just generator creation; abandoning the iterator records
+    nothing (there is no completed op to account)."""
+
+    __slots__ = ("_it", "_done")
+
+    def __init__(self, it: Iterator, done: Callable[[], None]):
+        self._it = iter(it)
+        self._done = done
+
+    def __iter__(self) -> "_TimedIterator":
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            done, self._done = self._done, lambda: None
+            done()
+            raise
+
+
+class DAOMetricsWrapper(base.LEvents):
+    """Time + error-count every event-store op against the registry."""
+
+    def __init__(self, wrapped: base.LEvents,
+                 backend: Optional[str] = None):
+        self._wrapped = wrapped
+        self.metrics_backend = backend or getattr(
+            wrapped, "metrics_backend", type(wrapped).__name__)
+
+    def unwrap(self) -> base.LEvents:
+        return self._wrapped
+
+    # -- accounting -------------------------------------------------------
+    def _record(self, op: str, t0: float,
+                error: Optional[BaseException] = None) -> None:
+        took = time.perf_counter() - t0
+        backend = self.metrics_backend
+        if error is not None:
+            metrics.STORAGE_OP_ERRORS.inc(
+                backend=backend, op=op, error=type(error).__name__)
+        else:
+            metrics.STORAGE_OP_LATENCY.observe(took, backend=backend, op=op)
+        if logger.isEnabledFor(logging.DEBUG):
+            rid = current_request_id() or "-"
+            logger.debug("storage %s.%s %.6fs rid=%s%s", backend, op, took,
+                         rid, f" error={error!r}" if error else "")
+
+    def _observe(self, op: str, fn: Callable, *args, **kwargs):
+        if not metrics.REGISTRY.enabled:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            result = fn(*args, **kwargs)
+        except BaseException as e:
+            self._record(op, t0, error=e)
+            raise
+        self._record(op, t0)
+        return result
+
+    # -- LEvents contract -------------------------------------------------
+    def init(self, app_id, channel_id=None) -> bool:
+        return self._observe("init", self._wrapped.init, app_id, channel_id)
+
+    def remove(self, app_id, channel_id=None) -> bool:
+        return self._observe("remove", self._wrapped.remove, app_id,
+                             channel_id)
+
+    def close(self) -> None:
+        self._wrapped.close()
+
+    def insert(self, event, app_id, channel_id=None) -> str:
+        return self._observe("insert", self._wrapped.insert, event, app_id,
+                             channel_id)
+
+    def insert_batch(self, events: Iterable, app_id, channel_id=None):
+        return self._observe("insert_batch", self._wrapped.insert_batch,
+                             events, app_id, channel_id)
+
+    def get(self, event_id, app_id, channel_id=None):
+        return self._observe("get", self._wrapped.get, event_id, app_id,
+                             channel_id)
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        return self._observe("delete", self._wrapped.delete, event_id,
+                             app_id, channel_id)
+
+    def delete_until(self, app_id, until_time, channel_id=None) -> int:
+        return self._observe("delete_until", self._wrapped.delete_until,
+                             app_id, until_time, channel_id)
+
+    def find(self, app_id, channel_id=None, **kwargs):
+        if not metrics.REGISTRY.enabled:
+            return self._wrapped.find(app_id, channel_id, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            it = self._wrapped.find(app_id, channel_id, **kwargs)
+        except BaseException as e:
+            self._record("find", t0, error=e)
+            raise
+        return _TimedIterator(it, lambda: self._record("find", t0))
+
+    def materialized_aggregate(self, app_id, entity_type, channel_id=None):
+        return self._observe(
+            "materialized_aggregate", self._wrapped.materialized_aggregate,
+            app_id, entity_type, channel_id)
+
+    def aggregate_properties_replay(self, app_id, entity_type,
+                                    channel_id=None, start_time=None,
+                                    until_time=None, required=None):
+        return self._observe(
+            "aggregate_replay", self._wrapped.aggregate_properties_replay,
+            app_id, entity_type, channel_id=channel_id,
+            start_time=start_time, until_time=until_time, required=required)
+
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        # delegate straight through: the wrapped DAO's own
+        # aggregate_properties does the hit/replay accounting, and its
+        # inner materialized/replay calls are the ones worth timing
+        return self._observe(
+            "aggregate", self._wrapped.aggregate_properties,
+            app_id, entity_type, channel_id=channel_id,
+            start_time=start_time, until_time=until_time, required=required)
+
+    # -- transparency -----------------------------------------------------
+    def __getattr__(self, name: str):
+        # only called for attributes NOT defined above (Python attribute
+        # protocol), so the LEvents surface stays timed and everything
+        # else (backend internals, shutdown, _w, _dir, ...) delegates
+        if name == "_wrapped":  # guard: never recurse before __init__ ran
+            raise AttributeError(name)
+        attr = getattr(self._wrapped, name)
+        if name in _EXTRA_TIMED_OPS and callable(attr):
+            def timed(*args, **kwargs):
+                return self._observe(name, attr, *args, **kwargs)
+            return timed
+        return attr
+
+    def __repr__(self) -> str:
+        return f"DAOMetricsWrapper({self._wrapped!r})"

@@ -155,6 +155,8 @@ def _scope(app_id: int, channel_id: Optional[int]) -> dict:
 class RestLEvents(base.LEvents):
     """LEvents client over the event server's storage wire."""
 
+    metrics_backend = "resthttp"
+
     def __init__(self, config: Optional[dict] = None):
         self._w = _Wire(config)
 
@@ -242,10 +244,21 @@ class RestLEvents(base.LEvents):
         status, payload = self._w.call(
             "GET", "/storage/aggregate.json", p, ok=(200, 404))
         if status == 404:
+            # super() does the hit/replay accounting for this path
             return super().aggregate_properties(
                 app_id, entity_type, channel_id=channel_id,
                 start_time=start_time, until_time=until_time,
                 required=required)
+        from predictionio_tpu.utils import metrics
+
+        if start_time is not None or until_time is not None:
+            # bounded reads ALWAYS replay server-side (base contract)
+            metrics.AGGREGATE_REPLAYS.inc(backend=self.metrics_backend,
+                                          reason="bounded")
+        # unbounded 200s are NOT counted as hits here: the server may
+        # have served them via its own replay fallback, and it is the
+        # server's base.aggregate_properties that counts hit vs replay
+        # truthfully under ITS backend label
         out = {}
         for eid, rec in payload.items():
             out[eid] = PropertyMap(

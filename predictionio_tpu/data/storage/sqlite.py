@@ -45,6 +45,7 @@ from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
     UNSET, AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
 )
+from predictionio_tpu.utils import metrics
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS events (
@@ -347,6 +348,8 @@ _EVENT_COLS = ("event_id, event, entity_type, entity_id, target_entity_type, "
 
 
 class SqliteLEvents(base.LEvents):
+    metrics_backend = "sqlite"
+
     def __init__(self, config: Optional[dict] = None):
         config = config or {}
         self._client = SqliteClient.shared(config.get("path", ":memory:"))
@@ -376,10 +379,14 @@ class SqliteLEvents(base.LEvents):
 
     @staticmethod
     def _drop_materialized(c, aid: int, chan: int) -> None:
+        cur = c.execute(
+            "DELETE FROM entity_props_scope WHERE app_id=? AND channel_id=?",
+            (aid, chan))
         c.execute("DELETE FROM entity_props WHERE app_id=? AND channel_id=?",
                   (aid, chan))
-        c.execute("DELETE FROM entity_props_scope"
-                  " WHERE app_id=? AND channel_id=?", (aid, chan))
+        if cur.rowcount:
+            metrics.AGGREGATE_SCOPE_DROPS.inc(amount=cur.rowcount,
+                                              backend="sqlite")
 
     @staticmethod
     def _load_state(c, aid: int, chan: int, etype: str,
@@ -530,6 +537,7 @@ class SqliteLEvents(base.LEvents):
                         "INSERT OR REPLACE INTO entity_props_scope"
                         " (app_id, channel_id, entity_type) VALUES (?,?,?)",
                         (aid, chan, entity_type))
+                    metrics.AGGREGATE_BACKFILLS.inc(backend="sqlite")
                     names = ",".join("?" * len(AGGREGATOR_EVENT_NAMES))
                     rows = c.execute(
                         f"SELECT entity_id, event, properties, event_time"

@@ -170,6 +170,10 @@ class HostTopK:
     def close(self) -> None:
         """Interface parity with DeviceTopK; nothing to release."""
 
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """Interface parity with DeviceTopK; no batchers host-side."""
+        return {}
+
     def _topk_row(self, scores: np.ndarray, k: int):
         k = min(k, scores.shape[0])
         top = np.argpartition(-scores, k - 1)[:k]
@@ -290,8 +294,25 @@ class _MicroBatcher:
         self._pending: List[_PendingQuery] = []
         self._thread: Optional[threading.Thread] = None
         self._closed = False
+        # stats live behind self._cv: they are written by the dispatcher
+        # thread and read by servers/benches, and they survive dispatcher
+        # restarts — unlocked += here raced with those reads
         self.dispatches = 0      # stats: device dispatches issued
         self.batched_queries = 0  # stats: queries served through them
+
+    def stats(self) -> Dict[str, int]:
+        """Consistent stats snapshot (one lock acquisition)."""
+        with self._cv:
+            return {"dispatches": self.dispatches,
+                    "batchedQueries": self.batched_queries,
+                    "queueDepth": len(self._pending),
+                    "maxBatch": self._max}
+
+    def _set_queue_gauge_locked(self) -> None:
+        from predictionio_tpu.utils import metrics
+
+        metrics.MICROBATCH_QUEUE_DEPTH.set(len(self._pending),
+                                           batcher=self.name)
 
     def submit(self, uid, k: int):
         item = _PendingQuery(uid, k)
@@ -307,6 +328,7 @@ class _MicroBatcher:
                     target=self._run, daemon=True, name=self.name)
                 self._thread.start()
             self._pending.append(item)
+            self._set_queue_gauge_locked()
             self._cv.notify()
         item.done.wait()
         if item.error is not None:
@@ -318,6 +340,7 @@ class _MicroBatcher:
         with self._cv:
             self._closed = True
             pending, self._pending = self._pending, []
+            self._set_queue_gauge_locked()
             self._cv.notify()
         for it in pending:
             it.error = RuntimeError("serving backend closed")
@@ -335,13 +358,22 @@ class _MicroBatcher:
                     return
                 group = self._pending[:self._max]
                 del self._pending[:self._max]
+                self._set_queue_gauge_locked()
             srv = self._srv_ref()
             try:
                 if srv is None:
                     raise RuntimeError("serving backend was released")
                 self._dispatch_group(srv, group)
-                self.dispatches += 1
-                self.batched_queries += len(group)
+                with self._cv:
+                    self.dispatches += 1
+                    self.batched_queries += len(group)
+                from predictionio_tpu.utils import metrics
+
+                metrics.MICROBATCH_DISPATCHES.inc(batcher=self.name)
+                metrics.MICROBATCH_QUERIES.inc(amount=len(group),
+                                               batcher=self.name)
+                metrics.MICROBATCH_BATCH_SIZE.observe(len(group),
+                                                      batcher=self.name)
             except BaseException as e:  # propagate to every waiter
                 for it in group:
                     it.error = e
@@ -552,6 +584,16 @@ class DeviceTopK:
             self._batcher.close()
         if self._item_batcher is not None:
             self._item_batcher.close()
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """Micro-batcher counters (consistent snapshots; also exported
+        process-wide as ``pio_microbatch_*`` registry metrics)."""
+        out: Dict[str, Dict[str, int]] = {}
+        if self._batcher is not None:
+            out["users"] = self._batcher.stats()
+        if self._item_batcher is not None:
+            out["items"] = self._item_batcher.stats()
+        return out
 
     # -- serving ----------------------------------------------------------
 

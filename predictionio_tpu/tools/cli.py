@@ -70,6 +70,14 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--engine-version", default=None)
 
 
+def _add_metrics_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--metrics", choices=("on", "off"), default=None,
+                   help="process-wide metrics instrumentation (default on; "
+                        "env PIO_METRICS=0 also disables). GET /metrics "
+                        "serves the Prometheus exposition either way — "
+                        "off just freezes the counters")
+
+
 def _add_distributed_args(p: argparse.ArgumentParser) -> None:
     """Multi-host topology flags (the spark-submit cluster plane analog,
     Runner.scala:92-210; see parallel/distributed.py for the launch
@@ -159,8 +167,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     train = sub.add_parser("train", help="train an engine instance")
     train.add_argument("--profile-dir", default=None,
-                       help="write a jax.profiler trace here "
-                            "(TensorBoard/Perfetto)")
+                       help="write a jax.profiler trace of the train pass "
+                            "here (TensorBoard/Perfetto); defaults to "
+                            "$PIO_PROFILE_DIR when set")
     _add_engine_args(train)
     train.add_argument("--batch", default="")
     train.add_argument("--skip-sanity-check", action="store_true")
@@ -189,6 +198,7 @@ def build_parser() -> argparse.ArgumentParser:
                      help="server.json with ssl cert/key for HTTPS "
                           "serving (default: $PIO_SERVER_CONFIG or "
                           "./server.json)")
+    _add_metrics_arg(dep)
     dep.set_defaults(func=run_commands.cmd_deploy)
 
     undep = sub.add_parser("undeploy", help="stop a deployed engine server")
@@ -209,6 +219,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--server-config", default=None, metavar="JSON",
         help="server.json with an ssl section (certfile/keyfile) to "
              "serve the whole event API over TLS")
+    _add_metrics_arg(es)
     es.set_defaults(func=run_commands.cmd_eventserver)
 
     adm = sub.add_parser("adminserver", help="start the admin REST server")

@@ -68,9 +68,22 @@ def cmd_build(args) -> int:
     return 0
 
 
+def _apply_metrics_flag(args) -> None:
+    """--metrics on|off -> the process-wide registry switch (None leaves
+    the PIO_METRICS env default in place)."""
+    flag = getattr(args, "metrics", None)
+    if flag is not None:
+        from predictionio_tpu.utils import metrics
+        metrics.set_enabled(flag == "on")
+
+
 def cmd_train(args) -> int:
-    """Console train (Console.scala:834-842) -> create_workflow."""
+    """Console train (Console.scala:834-842) -> create_workflow. A
+    profile dir (--profile-dir / $PIO_PROFILE_DIR) captures a
+    jax.profiler trace of the whole train pass, with JIT-compile
+    count/time accounted in the metrics registry."""
     from predictionio_tpu.core.base import TrainingInterruption
+    from predictionio_tpu.utils import metrics
     from predictionio_tpu.workflow.create_workflow import create_workflow
 
     from predictionio_tpu.utils.tracing import profile_trace
@@ -85,7 +98,10 @@ def cmd_train(args) -> int:
                   f"{distributed.process_count()}")
         variant = _load_variant(args.engine_variant)
         config = _workflow_config(args, variant)
-        with profile_trace(getattr(args, "profile_dir", None)):
+        profile_dir = getattr(args, "profile_dir", None) \
+            or os.environ.get("PIO_PROFILE_DIR") or None
+        metrics.install_jit_compile_listener()
+        with profile_trace(profile_dir):
             instance_id = create_workflow(config, variant=variant)
     except TrainingInterruption as e:
         print(f"[INFO] Training interrupted: {e}")
@@ -158,6 +174,7 @@ def cmd_deploy(args) -> int:
     COMPLETED engine instance until interrupted."""
     from predictionio_tpu.workflow import QueryServer, ServerConfig
 
+    _apply_metrics_flag(args)
     if args.feedback and not args.accesskey:
         # CreateServer.scala:452-455: feedback requires an access key
         print("[ERROR] Feedback loop cannot be enabled because accessKey "
@@ -218,6 +235,7 @@ def cmd_eventserver(args) -> int:
 
     from predictionio_tpu.data.api import EventServer, EventServerConfig
 
+    _apply_metrics_flag(args)
     service_key = getattr(args, "service_key", None) \
         or os.environ.get("PIO_EVENTSERVER_SERVICE_KEY") or None
     server = EventServer(EventServerConfig(

@@ -1,0 +1,86 @@
+"""Shared HTTP-handler instrumentation for the stdlib servers.
+
+Both daemons (event server ``data/api/event_server.py``, query server
+``workflow/create_server.py``) mount this mixin on their
+``BaseHTTPRequestHandler`` so request-id handling, response plumbing and
+per-route accounting stay identical by construction:
+
+- ``_dispatch_instrumented`` binds the request id (accepted from
+  ``X-Request-ID`` or minted) into the tracing contextvar, times the
+  request, and accounts it under ``pio_http_requests_total`` /
+  ``pio_http_request_seconds`` with the subclass's server label and
+  route pattern.
+- ``_respond`` / ``_respond_bytes`` echo the request id and record the
+  status the accounting reads.
+- ``_respond_prometheus`` serves the registry's text exposition.
+
+Subclasses set ``metrics_server_label`` and override ``_route_label``
+(route PATTERNS only — an id or client-chosen name must never mint a
+new series).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Optional
+
+from predictionio_tpu.utils import metrics
+from predictionio_tpu.utils.tracing import (
+    ensure_request_id,
+    reset_request_id,
+    set_request_id,
+)
+
+
+class InstrumentedHandlerMixin:
+    """Request-id + metrics plumbing over BaseHTTPRequestHandler."""
+
+    metrics_server_label = "unknown"  # subclass overrides
+
+    def _route_label(self, path: str) -> str:  # subclass overrides
+        return "<other>"
+
+    # -- responses ---------------------------------------------------------
+    def _respond(self, status: int, payload: Any) -> None:
+        self._respond_bytes(status, json.dumps(payload).encode("utf-8"),
+                            "application/json; charset=UTF-8")
+
+    def _respond_bytes(self, status: int, body: bytes,
+                       content_type: str) -> None:
+        self._status_sent = status
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        rid = getattr(self, "_request_id", None)
+        if rid:  # echo the request id for client-side correlation
+            self.send_header("X-Request-ID", rid)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _respond_prometheus(self) -> None:
+        self._respond_bytes(
+            200, metrics.registry().render_prometheus().encode("utf-8"),
+            "text/plain; version=0.0.4; charset=utf-8")
+
+    # -- dispatch shell ----------------------------------------------------
+    def _dispatch_instrumented(self, method: str, path: str,
+                               handle) -> None:
+        """Run ``handle()`` with the request id bound, then account the
+        request under its route pattern."""
+        self._request_id = ensure_request_id(
+            self.headers.get("X-Request-ID"))
+        self._status_sent: Optional[int] = None
+        token = set_request_id(self._request_id)
+        t0 = time.perf_counter()
+        try:
+            handle()
+        finally:
+            reset_request_id(token)
+            route = self._route_label(path)
+            metrics.HTTP_LATENCY.observe(
+                time.perf_counter() - t0,
+                server=self.metrics_server_label, route=route)
+            metrics.HTTP_REQUESTS.inc(
+                server=self.metrics_server_label, route=route,
+                method=method, status=str(self._status_sent or 0))

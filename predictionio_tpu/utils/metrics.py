@@ -1,0 +1,588 @@
+"""Process-wide metrics registry with Prometheus + JSON exposition.
+
+The reference's observability is a single running latency average in the
+query server (``CreateServer.scala:438-440,623-630``) and per-app ingest
+counters behind ``--stats`` (``Stats.scala``/``StatsActor.scala``);
+everything else is "look at the Spark UI". This module is the TPU
+build's substrate for first-class metrics:
+
+- :class:`Counter` / :class:`Gauge` / :class:`Histogram` — labeled,
+  thread-safe, registered in one process-wide :class:`MetricsRegistry`
+  (histograms reuse :class:`~predictionio_tpu.utils.tracing.
+  LatencyHistogram` as their sample store).
+- Two renderers over the same state: :meth:`MetricsRegistry.
+  render_prometheus` (text exposition: ``# HELP``/``# TYPE`` lines,
+  cumulative ``le`` buckets, ``_sum``/``_count`` series) and
+  :meth:`MetricsRegistry.snapshot` (JSON for ``/stats.json``). A
+  differential test asserts the two always agree.
+- A process-wide kill switch (:func:`set_enabled`, env ``PIO_METRICS=0``
+  or the servers' ``--metrics off`` flag): disabled, every ``inc``/
+  ``observe`` returns before touching a lock, so instrumentation can be
+  benchmarked off (the < 5% overhead gate in the bench harness).
+- :func:`install_jit_compile_listener` — wires ``jax.monitoring`` into
+  the registry so XLA compile count/time show up next to the DASE-stage
+  spans (the training-stall attribution ALX/TurboGR lean on).
+
+Naming conventions (documented in README "Observability"): every metric
+is ``pio_``-prefixed, durations are seconds, histograms are log-bucketed,
+label values are low-cardinality (routes are patterns, never raw paths).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.utils.tracing import LatencyHistogram
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+class MetricError(ValueError):
+    pass
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping: backslash, quote, newline."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    """Sample-value formatting: integers without a fraction, +Inf/-Inf
+    spelled the Prometheus way."""
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_le(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    return repr(float(v))
+
+
+def _label_str(names: Sequence[str], values: Sequence[str],
+               extra: Optional[Tuple[str, str]] = None) -> str:
+    pairs = [(n, v) for n, v in zip(names, values)]
+    if extra is not None:
+        pairs.append(extra)
+    if not pairs:
+        return ""
+    inner = ",".join(f'{n}="{_escape_label_value(v)}"' for n, v in pairs)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """One named metric family; children are per-label-set series."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str,
+                 label_names: Sequence[str]):
+        if not _NAME_RE.match(name):
+            raise MetricError(f"invalid metric name {name!r}")
+        for ln in label_names:
+            if not _LABEL_RE.match(ln):
+                raise MetricError(f"invalid label name {ln!r} on {name}")
+        self._registry = registry
+        self.name = name
+        self.help = help
+        self.label_names: Tuple[str, ...] = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise MetricError(
+                f"{self.name} expects labels {self.label_names}, "
+                f"got {tuple(sorted(labels))}")
+        return tuple(str(labels[n]) for n in self.label_names)
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def _child(self, labels: Dict[str, str]):
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child()
+                self._children[key] = child
+            return child
+
+    def labels(self, **labels: str):
+        """Get-or-create the series for one label set."""
+        return self._child(labels)
+
+    def _items(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._children.clear()
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise MetricError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Counter(_Metric):
+    """Monotonic labeled counter."""
+
+    kind = "counter"
+
+    def _new_child(self) -> _CounterChild:
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if not self._registry.enabled:
+            return
+        self._child(labels).inc(amount)
+
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+        return 0.0 if child is None else child.value
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "_value", "_fn")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._fn = None
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Pull gauge: ``fn`` is called at scrape time (e.g. live queue
+        depth) instead of pushing every transition."""
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:
+            return float("nan")
+
+
+class Gauge(_Metric):
+    """Labeled gauge; supports push (set/inc/dec) and pull
+    (set_function) styles."""
+
+    kind = "gauge"
+
+    def _new_child(self) -> _GaugeChild:
+        return _GaugeChild()
+
+    def set(self, value: float, **labels: str) -> None:
+        if not self._registry.enabled:
+            return
+        self._child(labels).set(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if not self._registry.enabled:
+            return
+        self._child(labels).inc(amount)
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def set_function(self, fn: Callable[[], float], **labels: str) -> None:
+        # registered even when disabled: pull gauges are scrape-time only
+        self._child(labels).set_function(fn)
+
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+        return 0.0 if child is None else child.value
+
+
+class Histogram(_Metric):
+    """Labeled histogram over :class:`LatencyHistogram` children."""
+
+    kind = "histogram"
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str,
+                 label_names: Sequence[str],
+                 buckets: Optional[Sequence[float]] = None):
+        super().__init__(registry, name, help, label_names)
+        self._buckets = None if buckets is None else tuple(buckets)
+
+    def _new_child(self) -> LatencyHistogram:
+        return LatencyHistogram(bounds=self._buckets)
+
+    def observe(self, value: float, **labels: str) -> None:
+        if not self._registry.enabled:
+            return
+        self._child(labels).record(value)
+
+    def time(self, **labels: str):
+        """Context manager recording the block's wall time."""
+        import contextlib
+        import time as _time
+
+        @contextlib.contextmanager
+        def timer():
+            t0 = _time.perf_counter()
+            try:
+                yield
+            finally:
+                self.observe(_time.perf_counter() - t0, **labels)
+        return timer()
+
+    def child(self, **labels: str) -> LatencyHistogram:
+        """The underlying LatencyHistogram (e.g. for ``summary()``)."""
+        return self._child(labels)
+
+
+class MetricsRegistry:
+    """Thread-safe name -> metric family registry.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create: calling twice
+    with the same (name, kind, labels) returns the same family, so any
+    module can declare the metrics it touches without import-order
+    coupling; a redefinition with a DIFFERENT kind or label set is a
+    programming error and raises.
+    """
+
+    def __init__(self, enabled: Optional[bool] = None):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        if enabled is None:
+            enabled = os.environ.get("PIO_METRICS", "1").strip().lower() \
+                not in ("0", "off", "false")
+        self.enabled = bool(enabled)
+
+    # -- declaration ------------------------------------------------------
+    def _declare(self, cls, name: str, help: str,
+                 label_names: Sequence[str], **kwargs) -> Any:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (type(existing) is not cls
+                        or existing.label_names != tuple(label_names)):
+                    raise MetricError(
+                        f"metric {name} already registered as "
+                        f"{existing.kind}{existing.label_names}")
+                if cls is Histogram:
+                    want = kwargs.get("buckets")
+                    want = None if want is None else tuple(want)
+                    if existing._buckets != want:
+                        # silently returning the first family would feed
+                        # the second declarer's observations into the
+                        # wrong bounds (e.g. minutes into a 5s-top scale)
+                        raise MetricError(
+                            f"histogram {name} already registered with "
+                            f"buckets {existing._buckets}, redeclared "
+                            f"with {want}")
+                return existing
+            metric = cls(self, name, help, label_names, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str,
+                label_names: Sequence[str] = ()) -> Counter:
+        return self._declare(Counter, name, help, label_names)
+
+    def gauge(self, name: str, help: str,
+              label_names: Sequence[str] = ()) -> Gauge:
+        return self._declare(Gauge, name, help, label_names)
+
+    def histogram(self, name: str, help: str,
+                  label_names: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._declare(Histogram, name, help, label_names,
+                             buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def reset(self) -> None:
+        """Drop every series (families stay declared) — test isolation."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.clear()
+
+    # -- renderers --------------------------------------------------------
+    def _families(self) -> List[_Metric]:
+        with self._lock:
+            return sorted(self._metrics.values(), key=lambda m: m.name)
+
+    def render_prometheus(self) -> str:
+        """Text exposition format (version 0.0.4): ``# HELP``/``# TYPE``
+        per family, cumulative ``le`` buckets + ``_sum``/``_count`` for
+        histograms."""
+        lines: List[str] = []
+        for m in self._families():
+            items = m._items()
+            if not items:
+                continue
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, child in items:
+                if m.kind == "histogram":
+                    counts, total, sum_, _mx, _last = child.snapshot()
+                    bounds = child.bounds
+                    for i, acc in enumerate(
+                            LatencyHistogram.cumulate(counts)):
+                        le = bounds[i] if i < len(bounds) else math.inf
+                        ls = _label_str(m.label_names, key,
+                                        extra=("le", _fmt_le(le)))
+                        lines.append(f"{m.name}_bucket{ls} {acc}")
+                    ls = _label_str(m.label_names, key)
+                    lines.append(f"{m.name}_sum{ls} {repr(float(sum_))}")
+                    lines.append(f"{m.name}_count{ls} {total}")
+                else:
+                    ls = _label_str(m.label_names, key)
+                    lines.append(f"{m.name}{ls} {_fmt_value(child.value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON view of the same state the Prometheus renderer exposes
+        (``/stats.json``). Histogram series carry BOTH the cumulative
+        ``le`` buckets (scrape parity) and the percentile summary."""
+        out: Dict[str, Any] = {}
+        for m in self._families():
+            items = m._items()
+            if not items:
+                continue
+            series = []
+            for key, child in items:
+                labels = dict(zip(m.label_names, key))
+                if m.kind == "histogram":
+                    counts, total, sum_, mx, last = child.snapshot()
+                    buckets = []
+                    bounds = child.bounds
+                    for i, acc in enumerate(
+                            LatencyHistogram.cumulate(counts)):
+                        le = bounds[i] if i < len(bounds) else math.inf
+                        buckets.append({"le": _fmt_le(le),
+                                        "cumulative": acc})
+                    series.append({
+                        "labels": labels,
+                        "count": total,
+                        "sum": sum_,
+                        "max": mx,
+                        "last": last,
+                        "buckets": buckets,
+                        "summary": child.summary(),
+                    })
+                else:
+                    series.append({"labels": labels, "value": child.value})
+            out[m.name] = {"type": m.kind, "help": m.help, "series": series}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# The process-wide registry + the metric families every layer shares
+# ---------------------------------------------------------------------------
+
+REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return REGISTRY
+
+
+def set_enabled(enabled: bool) -> None:
+    """Process-wide instrumentation switch (``--metrics on|off`` /
+    ``PIO_METRICS``). Disabled, every inc/observe returns before taking
+    a lock; declared families and live series stay readable."""
+    REGISTRY.enabled = bool(enabled)
+
+
+# power-of-two-ish counts for batch sizes / queue depths
+COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0)
+
+# long-running work (training stages): seconds to hours — the default
+# latency bounds top out at 5s and would collapse real stage times into
+# the +Inf bucket
+LONG_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0,
+                1800.0, 7200.0)
+
+# -- HTTP serving (event server + query server) ----------------------------
+HTTP_REQUESTS = REGISTRY.counter(
+    "pio_http_requests_total",
+    "HTTP requests by server, route pattern, method and status code",
+    ("server", "route", "method", "status"))
+HTTP_LATENCY = REGISTRY.histogram(
+    "pio_http_request_seconds",
+    "End-to-end HTTP request latency by server and route pattern",
+    ("server", "route"))
+
+# -- ingest (event server) -------------------------------------------------
+INGEST_EVENTS = REGISTRY.counter(
+    "pio_ingest_events_total",
+    "Ingested events by app, event type and response status",
+    ("app_id", "event", "status"))
+
+# -- query serving ---------------------------------------------------------
+QUERY_LATENCY = REGISTRY.histogram(
+    "pio_query_seconds",
+    "Query-path latency (extract+predict+serve) per engine variant",
+    ("variant",))
+MICROBATCH_QUERIES = REGISTRY.counter(
+    "pio_microbatch_queries_total",
+    "Queries served through a micro-batched device dispatch",
+    ("batcher",))
+MICROBATCH_DISPATCHES = REGISTRY.counter(
+    "pio_microbatch_dispatches_total",
+    "Device dispatches issued by the micro-batcher",
+    ("batcher",))
+MICROBATCH_QUEUE_DEPTH = REGISTRY.gauge(
+    "pio_microbatch_queue_depth",
+    "Requests currently waiting in the micro-batcher queue",
+    ("batcher",))
+MICROBATCH_BATCH_SIZE = REGISTRY.histogram(
+    "pio_microbatch_batch_size",
+    "Queries merged into one device dispatch",
+    ("batcher",), buckets=COUNT_BUCKETS)
+
+# -- storage ---------------------------------------------------------------
+STORAGE_OP_LATENCY = REGISTRY.histogram(
+    "pio_storage_op_seconds",
+    "Event-store DAO operation latency by backend and op",
+    ("backend", "op"))
+STORAGE_OP_ERRORS = REGISTRY.counter(
+    "pio_storage_op_errors_total",
+    "Event-store DAO operation failures by backend, op and error class",
+    ("backend", "op", "error"))
+
+# -- materialized entity-property aggregation (PR 1) -----------------------
+AGGREGATE_HITS = REGISTRY.counter(
+    "pio_aggregate_hits_total",
+    "aggregate_properties reads served from materialized state",
+    ("backend",))
+AGGREGATE_REPLAYS = REGISTRY.counter(
+    "pio_aggregate_replays_total",
+    "aggregate_properties reads that replayed event history "
+    "(bounded = time-travel query; fallback = no/failed materialized state)",
+    ("backend", "reason"))
+AGGREGATE_BACKFILLS = REGISTRY.counter(
+    "pio_aggregate_backfills_total",
+    "Materialized-aggregation scope backfills (full history refolds)",
+    ("backend",))
+AGGREGATE_SCOPE_DROPS = REGISTRY.counter(
+    "pio_aggregate_scope_drops_total",
+    "Materialized-aggregation scope invalidations (partition rewrites, "
+    "bulk deletes, app removals)",
+    ("backend",))
+
+# -- training workflow -----------------------------------------------------
+TRAIN_STAGE_LATENCY = REGISTRY.histogram(
+    "pio_train_stage_seconds",
+    "DASE pipeline stage wall time (read/prepare/train/eval)",
+    ("stage",), buckets=LONG_BUCKETS)
+JIT_COMPILES = REGISTRY.counter(
+    "pio_jit_compiles_total",
+    "XLA compilations observed via jax.monitoring", ())
+JIT_COMPILE_SECONDS = REGISTRY.counter(
+    "pio_jit_compile_seconds_total",
+    "Cumulative XLA compile wall time via jax.monitoring", ())
+PROFILE_TRACES = REGISTRY.counter(
+    "pio_profile_traces_total",
+    "jax.profiler traces captured by profile_trace", ())
+
+
+class BoundedLabel:
+    """Cap the distinct values a CLIENT-CONTROLLED label may mint.
+
+    Series live for the process lifetime, so a label fed from request
+    data (e.g. event names) would otherwise be an unbounded-memory lever
+    for any client with an access key. The first ``cap`` distinct values
+    keep their identity; everything after collapses to ``overflow``.
+    """
+
+    def __init__(self, cap: int = 100, overflow: str = "<other>"):
+        self._cap = int(cap)
+        self._overflow = overflow
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def __call__(self, value: str) -> str:
+        v = str(value)
+        with self._lock:
+            if v in self._seen:
+                return v
+            if len(self._seen) < self._cap:
+                self._seen.add(v)
+                return v
+        return self._overflow
+
+
+_jit_listener_lock = threading.Lock()
+_jit_listener_installed = False
+
+
+def install_jit_compile_listener() -> bool:
+    """Register a ``jax.monitoring`` duration listener feeding the
+    JIT-compile counters (idempotent; False when the running jax has no
+    monitoring API). The listener is a no-op while the registry is
+    disabled, so installing it does not tax a metrics-off process."""
+    global _jit_listener_installed
+    with _jit_listener_lock:
+        if _jit_listener_installed:
+            return True
+        try:
+            from jax import monitoring as _monitoring
+            register = _monitoring.register_event_duration_secs_listener
+        except (ImportError, AttributeError):
+            return False
+
+        def _on_duration(event: str, duration: float, **kwargs) -> None:
+            if not REGISTRY.enabled:
+                return
+            if "compile" in event:
+                JIT_COMPILES.inc()
+                JIT_COMPILE_SECONDS.inc(max(0.0, float(duration)))
+
+        register(_on_duration)
+        _jit_listener_installed = True
+        return True

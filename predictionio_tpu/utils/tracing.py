@@ -7,20 +7,29 @@ observability:
 - :class:`LatencyHistogram` — thread-safe log-bucketed latency histogram
   with percentile estimates, used by the query server for per-query
   serving times (replacing the reference's single running average,
-  ``CreateServer.scala:438-440,623-630``).
+  ``CreateServer.scala:438-440,623-630``) and as the sample store behind
+  every :class:`~predictionio_tpu.utils.metrics.Histogram` in the
+  process-wide metrics registry.
+- request-scoped tracing: :func:`ensure_request_id` accepts or mints an
+  ``X-Request-ID``, carried through a :mod:`contextvars` var so
+  :func:`span` log lines and storage-op records can attribute work to
+  the request that caused it, across the thread handling it.
 - :func:`profile_trace` — wraps a block in a ``jax.profiler`` trace
   (viewable in TensorBoard/Perfetto) when a directory is given; the
   Spark-UI analog for XLA programs.
-- :func:`span` — debug-log a named wall-clock span.
+- :func:`span` — debug-log a named wall-clock span (request-id tagged).
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import logging
+import re
+import secrets
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger("pio.tracing")
 
@@ -30,24 +39,34 @@ _BOUNDS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5,
 
 
 class LatencyHistogram:
-    """Thread-safe latency histogram with percentile estimation.
+    """Thread-safe histogram with percentile estimation.
 
     Percentiles are estimated by linear interpolation inside the matched
     bucket — good to within a bucket width, which is what a serving
-    dashboard needs.
+    dashboard needs. Default bounds are latency-shaped (seconds, log
+    scale); pass ``bounds`` to count other magnitudes (batch sizes,
+    queue depths).
     """
 
-    def __init__(self):
+    def __init__(self, bounds: Optional[Sequence[float]] = None):
+        self._bounds: Tuple[float, ...] = (
+            _BOUNDS if bounds is None else tuple(float(b) for b in bounds))
+        if any(b2 <= b1 for b1, b2 in zip(self._bounds, self._bounds[1:])):
+            raise ValueError("histogram bounds must be strictly increasing")
         self._lock = threading.Lock()
-        self._counts = [0] * (len(_BOUNDS) + 1)
+        self._counts = [0] * (len(self._bounds) + 1)
         self._total = 0
         self._sum = 0.0
         self._max = 0.0
         self._last = 0.0
 
+    @property
+    def bounds(self) -> Tuple[float, ...]:
+        return self._bounds
+
     def record(self, seconds: float) -> None:
         i = 0
-        while i < len(_BOUNDS) and seconds > _BOUNDS[i]:
+        while i < len(self._bounds) and seconds > self._bounds[i]:
             i += 1
         with self._lock:
             self._counts[i] += 1
@@ -64,8 +83,8 @@ class LatencyHistogram:
         acc = 0
         for i, c in enumerate(self._counts):
             if acc + c >= target and c > 0:
-                lo = 0.0 if i == 0 else _BOUNDS[i - 1]
-                hi = _BOUNDS[i] if i < len(_BOUNDS) else self._max
+                lo = 0.0 if i == 0 else self._bounds[i - 1]
+                hi = self._bounds[i] if i < len(self._bounds) else self._max
                 frac = (target - acc) / c
                 return lo + (max(hi, lo) - lo) * frac
             acc += c
@@ -74,9 +93,10 @@ class LatencyHistogram:
     def summary(self) -> Dict[str, object]:
         with self._lock:
             if self._total == 0:
-                return {"count": 0}
+                return {"count": 0, "sumSec": 0.0}
             return {
                 "count": self._total,
+                "sumSec": self._sum,
                 "meanSec": self._sum / self._total,
                 "lastSec": self._last,
                 "maxSec": self._max,
@@ -86,35 +106,161 @@ class LatencyHistogram:
             }
 
     def buckets(self) -> List[Dict[str, object]]:
+        """Per-bucket counts (NOT cumulative; see :meth:`cumulative` for
+        the Prometheus ``le`` view)."""
         with self._lock:
             counts = list(self._counts)
         out = []
         for i, c in enumerate(counts):
-            le = _BOUNDS[i] if i < len(_BOUNDS) else float("inf")
+            le = self._bounds[i] if i < len(self._bounds) else float("inf")
             out.append({"le": le, "count": c})
         return out
+
+    @staticmethod
+    def cumulate(counts: Sequence[int]) -> List[int]:
+        """Per-bucket counts -> cumulative ``le`` counts. THE accumulation
+        rule of the Prometheus histogram contract — both registry
+        renderers and :meth:`cumulative` route through it so the
+        exposition can never drift from this method."""
+        out = []
+        acc = 0
+        for c in counts:
+            acc += c
+            out.append(acc)
+        return out
+
+    def cumulative(self) -> List[Dict[str, object]]:
+        """Cumulative ``le`` buckets — the Prometheus histogram contract:
+        each bucket counts every observation ≤ its bound, and the +inf
+        bucket equals the total count (scrape-correct exposition)."""
+        with self._lock:
+            counts = list(self._counts)
+        out = []
+        for i, acc in enumerate(self.cumulate(counts)):
+            le = self._bounds[i] if i < len(self._bounds) else float("inf")
+            out.append({"le": le, "count": acc})
+        return out
+
+    def snapshot(self) -> Tuple[List[int], int, float, float, float]:
+        """Consistent (counts, total, sum, max, last) under one lock."""
+        with self._lock:
+            return (list(self._counts), self._total, self._sum, self._max,
+                    self._last)
+
+    def merge(self, other: "LatencyHistogram") -> None:
+        """Fold ``other``'s observations into this histogram (registry
+        snapshot aggregation). Bounds must match; ``other`` is read under
+        its own lock first so the merge never holds both locks at once."""
+        if other._bounds != self._bounds:
+            raise ValueError("cannot merge histograms with different bounds")
+        counts, total, sum_, max_, last = other.snapshot()
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._total += total
+            self._sum += sum_
+            if max_ > self._max:
+                self._max = max_
+            if total:
+                self._last = last
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self._bounds) + 1)
+            self._total = 0
+            self._sum = 0.0
+            self._max = 0.0
+            self._last = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped tracing
+# ---------------------------------------------------------------------------
+
+# The id of the HTTP request (or CLI run) the current thread is working
+# for. contextvars propagate per-thread here: each server handler thread
+# sets it on entry, so storage-op records and span() lines deep in the
+# stack attribute themselves without any parameter threading.
+_request_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "pio_request_id", default=None)
+
+# wire-safe id: printable, header-friendly, bounded
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,128}$")
+
+
+def current_request_id() -> Optional[str]:
+    return _request_id.get()
+
+
+def set_request_id(rid: Optional[str]) -> contextvars.Token:
+    """Bind the current context to ``rid``; returns the token for
+    :func:`reset_request_id`."""
+    return _request_id.set(rid)
+
+
+def reset_request_id(token: contextvars.Token) -> None:
+    _request_id.reset(token)
+
+
+def ensure_request_id(given: Optional[str] = None) -> str:
+    """Accept a client-supplied ``X-Request-ID`` when it is wire-safe,
+    else mint a fresh one (16 hex chars)."""
+    if given and _REQUEST_ID_RE.match(given):
+        return given
+    return secrets.token_hex(8)
+
+
+@contextlib.contextmanager
+def request_scope(given: Optional[str] = None):
+    """Context manager binding a request id for the block; yields the id."""
+    rid = ensure_request_id(given)
+    token = set_request_id(rid)
+    try:
+        yield rid
+    finally:
+        reset_request_id(token)
 
 
 @contextlib.contextmanager
 def profile_trace(trace_dir: Optional[str] = None):
     """Capture a jax.profiler trace of the block into ``trace_dir``
     (no-op when None). View with TensorBoard's profile plugin or
-    Perfetto."""
+    Perfetto. Each capture is counted in the metrics registry
+    (``pio_profile_traces_total``) and, as a side effect of the first
+    call, installs the JIT-compile listener so compile count/time show
+    up alongside the trace."""
     if not trace_dir:
         yield
         return
+    from predictionio_tpu.utils import metrics
+
+    metrics.install_jit_compile_listener()
     import jax
 
+    t0 = time.perf_counter()
     with jax.profiler.trace(trace_dir):
         yield
-    logger.info("profiler trace written to %s", trace_dir)
+    metrics.PROFILE_TRACES.inc()
+    logger.info("profiler trace written to %s (%.3fs)", trace_dir,
+                time.perf_counter() - t0)
 
 
 @contextlib.contextmanager
-def span(name: str, level: int = logging.DEBUG):
-    """Log the wall-clock duration of a block."""
+def span(name: str, level: int = logging.DEBUG,
+         histogram: Optional[LatencyHistogram] = None):
+    """Log the wall-clock duration of a block, tagged with the current
+    request id (when one is bound) so concurrent servers produce
+    attributable logs. ``histogram`` additionally records the duration
+    (how the DASE-stage spans feed ``pio_train_stage_seconds``)."""
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        logger.log(level, "%s took %.3fs", name, time.perf_counter() - t0)
+        took = time.perf_counter() - t0
+        if histogram is not None:
+            histogram.record(took)
+        rid = current_request_id()
+        if rid:
+            logger.log(level, "%s took %.3fs [rid=%s]", name, took, rid)
+        else:
+            logger.log(level, "%s took %.3fs", name, took)

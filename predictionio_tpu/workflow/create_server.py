@@ -47,6 +47,10 @@ from predictionio_tpu.core.base import WorkflowParams
 from predictionio_tpu.core.context import ComputeContext, workflow_context
 from predictionio_tpu.data import storage
 from predictionio_tpu.data.storage.base import EngineInstance, StorageError
+from predictionio_tpu.utils import metrics
+from predictionio_tpu.utils.http_instrumentation import (
+    InstrumentedHandlerMixin,
+)
 from predictionio_tpu.utils.tracing import LatencyHistogram
 from predictionio_tpu.workflow import core_workflow
 from predictionio_tpu.workflow.server_plugins import EngineServerPluginContext
@@ -193,6 +197,10 @@ class QueryServer:
         self.ctx = ctx or workflow_context(mode="serving", batch=config.batch)
         self._deployment: Optional[_Deployment] = None
         self._swap_lock = threading.Lock()
+        # per-SERVER latency (status page bookkeeping); every record also
+        # feeds the process-wide per-variant registry histogram
+        # (pio_query_seconds{variant=...}) — the reference's running
+        # average (CreateServer.scala:438-440) generalized twice over
         self.latency = LatencyHistogram()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -351,7 +359,10 @@ class QueryServer:
             except Exception:
                 logger.exception("output sniffer failed")
 
-        self.latency.record(time.perf_counter() - t0)
+        took = time.perf_counter() - t0
+        self.latency.record(took)
+        metrics.QUERY_LATENCY.observe(took,
+                                      variant=self.config.engine_variant)
         return 200, result
 
     def _feedback(self, dep: _Deployment, query_dict: Mapping[str, Any],
@@ -432,6 +443,13 @@ class QueryServer:
             "lastServingSec": summary.get("lastSec", 0.0),
             "servingLatency": summary,
         }
+
+    def stats_json(self) -> Dict[str, Any]:
+        """GET /stats.json: the status page plus the process-wide
+        registry snapshot (pio_query_seconds, pio_microbatch_*,
+        pio_storage_op_* ... — the same state GET /metrics renders as
+        Prometheus text)."""
+        return {**self.status(), "metrics": metrics.registry().snapshot()}
 
     # -- HTTP lifecycle ----------------------------------------------------
     def start(self, undeploy_stale: bool = True,
@@ -535,39 +553,46 @@ def undeploy(ip: str, port: int, scheme: str = "http") -> bool:
         return False
 
 
-class _QueryHandler(BaseHTTPRequestHandler):
+class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
     query_server: QueryServer
     protocol_version = "HTTP/1.1"
+    metrics_server_label = "query"
 
     def log_message(self, fmt, *args):
         logger.debug("%s - %s", self.address_string(), fmt % args)
-
-    def _respond(self, status: int, payload: Any) -> None:
-        body = json.dumps(payload).encode("utf-8")
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json; charset=UTF-8")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
 
     def _drain(self) -> bytes:
         length = int(self.headers.get("Content-Length") or 0)
         return self.rfile.read(length) if length else b""
 
-    def do_GET(self):
-        srv = self.query_server
+    _ROUTES = ("/", "/metrics", "/stats.json", "/plugins.json",
+               "/queries.json", "/reload", "/stop")
+
+    def _route_label(self, path: str) -> str:
+        return path if path in self._ROUTES else "<other>"
+
+    def _dispatch(self, method: str) -> None:
         path = urllib.parse.urlsplit(self.path).path.rstrip("/") or "/"
+        handle = (lambda: self._do_get(path)) if method == "GET" \
+            else (lambda: self._do_post(path))
+        self._dispatch_instrumented(method, path, handle)
+
+    def _do_get(self, path: str) -> None:
+        srv = self.query_server
         self._drain()
         if path == "/":
             self._respond(200, srv.status())
+        elif path == "/metrics":
+            self._respond_prometheus()
+        elif path == "/stats.json":
+            self._respond(200, srv.stats_json())
         elif path == "/plugins.json":
             self._respond(200, srv.plugin_context.describe())
         else:
             self._respond(404, {"message": "Not Found"})
 
-    def do_POST(self):
+    def _do_post(self, path: str) -> None:
         srv = self.query_server
-        path = urllib.parse.urlsplit(self.path).path.rstrip("/") or "/"
         body = self._drain()
         try:
             if path == "/queries.json":
@@ -588,6 +613,12 @@ class _QueryHandler(BaseHTTPRequestHandler):
                 self._respond(500, {"message": str(e)})
             except Exception:
                 pass
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
 
 
 def create_server(config: ServerConfig, **kwargs) -> QueryServer:

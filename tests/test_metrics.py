@@ -1,0 +1,393 @@
+"""Metrics registry: counters/gauges/histograms, both renderers, the
+JSON↔Prometheus differential, concurrency stress, and the DAO wrapper's
+latency/error accounting."""
+
+import math
+import re
+import threading
+
+import pytest
+
+from predictionio_tpu.utils.metrics import (
+    MetricError,
+    MetricsRegistry,
+)
+from predictionio_tpu.utils.tracing import LatencyHistogram
+
+
+def parse_prometheus(text):
+    """Text exposition -> {(name, sorted-label-tuple): value}. Also
+    returns the per-family # TYPE map. Raises on malformed lines, so the
+    endpoint tests double as format validation."""
+    samples = {}
+    types = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = re.match(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$', line)
+        assert m, f"malformed exposition line: {line!r}"
+        name, labelstr, value = m.groups()
+        labels = {}
+        if labelstr:
+            for lm in re.finditer(r'([a-zA-Z_][a-zA-Z0-9_]*)='
+                                  r'"((?:[^"\\]|\\.)*)"', labelstr):
+                labels[lm.group(1)] = (
+                    lm.group(2).replace("\\n", "\n")
+                    .replace('\\"', '"').replace("\\\\", "\\"))
+        if value == "+Inf":
+            v = math.inf
+        elif value == "-Inf":
+            v = -math.inf
+        else:
+            v = float(value)
+        samples[(name, tuple(sorted(labels.items())))] = v
+    return samples, types
+
+
+class TestLatencyHistogramExtensions:
+    def test_cumulative_le_buckets(self):
+        h = LatencyHistogram()
+        for s in (0.0001, 0.0008, 0.003, 0.003, 100.0):
+            h.record(s)
+        cum = h.cumulative()
+        counts = [b["count"] for b in cum]
+        # monotone non-decreasing, +inf bucket == total
+        assert counts == sorted(counts)
+        assert cum[-1]["le"] == math.inf and cum[-1]["count"] == 5
+        # per-bucket view still sums (not cumulative)
+        assert sum(b["count"] for b in h.buckets()) == 5
+
+    def test_summary_sum_sec(self):
+        h = LatencyHistogram()
+        h.record(0.25)
+        h.record(0.75)
+        assert h.summary()["sumSec"] == pytest.approx(1.0)
+
+    def test_merge_and_reset(self):
+        a, b = LatencyHistogram(), LatencyHistogram()
+        a.record(0.001)
+        b.record(2.0)
+        b.record(0.1)
+        a.merge(b)
+        s = a.summary()
+        assert s["count"] == 3
+        assert s["sumSec"] == pytest.approx(2.101)
+        assert s["maxSec"] == pytest.approx(2.0)
+        a.reset()
+        assert a.summary() == {"count": 0, "sumSec": 0.0}
+
+    def test_merge_bounds_mismatch(self):
+        with pytest.raises(ValueError):
+            LatencyHistogram().merge(LatencyHistogram(bounds=(1.0, 2.0)))
+
+    def test_custom_bounds_validated(self):
+        with pytest.raises(ValueError):
+            LatencyHistogram(bounds=(1.0, 1.0))
+
+
+class TestRegistry:
+    def test_counter_and_labels(self):
+        r = MetricsRegistry(enabled=True)
+        c = r.counter("t_ops_total", "ops", ("kind",))
+        c.inc(kind="a")
+        c.inc(2, kind="a")
+        c.inc(kind="b")
+        assert c.value(kind="a") == 3
+        assert c.value(kind="b") == 1
+        assert c.value(kind="never") == 0
+
+    def test_counter_monotonic(self):
+        r = MetricsRegistry(enabled=True)
+        c = r.counter("t_mono_total", "m", ())
+        with pytest.raises(MetricError):
+            c.inc(-1)
+
+    def test_label_mismatch_raises(self):
+        r = MetricsRegistry(enabled=True)
+        c = r.counter("t_lbl_total", "m", ("a",))
+        with pytest.raises(MetricError):
+            c.inc(b="x")
+
+    def test_redeclare_same_ok_conflict_raises(self):
+        r = MetricsRegistry(enabled=True)
+        c1 = r.counter("t_re_total", "m", ("a",))
+        assert r.counter("t_re_total", "m", ("a",)) is c1
+        with pytest.raises(MetricError):
+            r.gauge("t_re_total", "m", ("a",))
+        with pytest.raises(MetricError):
+            r.counter("t_re_total", "m", ("a", "b"))
+
+    def test_redeclare_histogram_bucket_conflict_raises(self):
+        r = MetricsRegistry(enabled=True)
+        h1 = r.histogram("t_reb_seconds", "m", (), buckets=(1.0, 2.0))
+        assert r.histogram("t_reb_seconds", "m", (),
+                           buckets=(1.0, 2.0)) is h1
+        with pytest.raises(MetricError):
+            r.histogram("t_reb_seconds", "m", ())  # default bounds
+        with pytest.raises(MetricError):
+            r.histogram("t_reb_seconds", "m", (), buckets=(1.0, 5.0))
+
+    def test_gauge_push_and_pull(self):
+        r = MetricsRegistry(enabled=True)
+        g = r.gauge("t_gauge", "g", ("k",))
+        g.set(5, k="x")
+        g.inc(k="x")
+        g.dec(3, k="x")
+        assert g.value(k="x") == 3
+        g.set_function(lambda: 42, k="pull")
+        assert g.value(k="pull") == 42
+
+    def test_disabled_registry_is_noop(self):
+        r = MetricsRegistry(enabled=False)
+        c = r.counter("t_off_total", "m", ())
+        h = r.histogram("t_off_seconds", "m", ())
+        c.inc()
+        h.observe(0.1)
+        assert c.value() == 0
+        assert r.render_prometheus() == ""
+        r.enabled = True
+        c.inc()
+        assert c.value() == 1
+
+    def test_invalid_names(self):
+        r = MetricsRegistry(enabled=True)
+        with pytest.raises(MetricError):
+            r.counter("bad-name", "m", ())
+        with pytest.raises(MetricError):
+            r.counter("ok_total", "m", ("bad-label",))
+
+
+class TestRenderers:
+    def _populated(self):
+        r = MetricsRegistry(enabled=True)
+        c = r.counter("t_req_total", "requests", ("route", "status"))
+        c.inc(3, route="/a", status="200")
+        c.inc(route="/a", status="500")
+        g = r.gauge("t_depth", "queue depth", ("q",))
+        g.set(7, q="main")
+        h = r.histogram("t_lat_seconds", "latency", ("route",))
+        for v in (0.0001, 0.004, 0.03, 3.0, 100.0):
+            h.observe(v, route="/a")
+        return r
+
+    def test_prometheus_format(self):
+        r = self._populated()
+        text = r.render_prometheus()
+        samples, types = parse_prometheus(text)
+        assert types["t_req_total"] == "counter"
+        assert types["t_depth"] == "gauge"
+        assert types["t_lat_seconds"] == "histogram"
+        assert samples[("t_req_total",
+                        (("route", "/a"), ("status", "200")))] == 3
+        assert samples[("t_depth", (("q", "main"),))] == 7
+        # histogram: _count, _sum, and a cumulative +Inf bucket == count
+        assert samples[("t_lat_seconds_count", (("route", "/a"),))] == 5
+        assert samples[("t_lat_seconds_sum",
+                        (("route", "/a"),))] == pytest.approx(103.0341)
+        assert samples[("t_lat_seconds_bucket",
+                        (("le", "+Inf"), ("route", "/a")))] == 5
+        # cumulative buckets are monotone in le order
+        buckets = sorted(
+            ((dict(k[1])["le"], v) for k, v in samples.items()
+             if k[0] == "t_lat_seconds_bucket"),
+            key=lambda p: math.inf if p[0] == "+Inf" else float(p[0]))
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts)
+
+    def test_label_escaping(self):
+        r = MetricsRegistry(enabled=True)
+        c = r.counter("t_esc_total", "m", ("v",))
+        nasty = 'a"b\\c\nd'
+        c.inc(v=nasty)
+        samples, _ = parse_prometheus(r.render_prometheus())
+        assert samples[("t_esc_total", (("v", nasty),))] == 1
+
+    def test_json_prometheus_differential(self):
+        """The acceptance differential: both renderers must agree on
+        every series — counter/gauge values, histogram counts, sums and
+        every cumulative bucket."""
+        r = self._populated()
+        samples, _ = parse_prometheus(r.render_prometheus())
+        snap = r.snapshot()
+        checked = 0
+        for name, fam in snap.items():
+            for s in fam["series"]:
+                key = tuple(sorted(s["labels"].items()))
+                if fam["type"] == "histogram":
+                    assert samples[(f"{name}_count", key)] == s["count"]
+                    assert samples[(f"{name}_sum", key)] == \
+                        pytest.approx(s["sum"])
+                    for b in s["buckets"]:
+                        bkey = tuple(sorted(
+                            list(s["labels"].items()) + [("le", b["le"])]))
+                        assert samples[(f"{name}_bucket", bkey)] == \
+                            b["cumulative"]
+                        checked += 1
+                else:
+                    assert samples[(name, key)] == pytest.approx(s["value"])
+                checked += 1
+        # and nothing rendered that the snapshot does not carry
+        json_series = sum(
+            (len(f["series"]) * (1 if f["type"] != "histogram" else 1)
+             for f in snap.values()))
+        assert checked >= json_series > 0
+
+    def test_reset_drops_series(self):
+        r = self._populated()
+        r.reset()
+        assert r.render_prometheus() == ""
+        assert r.snapshot() == {}
+
+
+class TestBoundedLabel:
+    def test_caps_distinct_values(self):
+        from predictionio_tpu.utils.metrics import BoundedLabel
+
+        lbl = BoundedLabel(cap=3, overflow="<other>")
+        assert [lbl(v) for v in ("a", "b", "a", "c")] == \
+            ["a", "b", "a", "c"]
+        # cap reached: new values collapse, known ones keep identity
+        assert lbl("d") == "<other>"
+        assert lbl("b") == "b"
+
+    def test_train_stage_buckets_cover_long_stages(self):
+        from predictionio_tpu.utils import metrics
+
+        # a 10-minute train stage must land in a FINITE bucket, not +Inf
+        # (the default latency bounds top out at 5s)
+        bounds = metrics.TRAIN_STAGE_LATENCY.child(stage="read").bounds
+        assert max(bounds) >= 3600.0
+        assert any(b >= 600.0 for b in bounds)
+
+
+class TestConcurrency:
+    def test_threads_times_labels_stress(self):
+        """Concurrent inc/observe across threads and label sets must
+        lose nothing and corrupt nothing."""
+        r = MetricsRegistry(enabled=True)
+        c = r.counter("t_stress_total", "m", ("worker", "shared"))
+        h = r.histogram("t_stress_seconds", "m", ("shared",))
+        N_THREADS, N_ITER = 8, 2000
+        errors = []
+
+        def work(tx):
+            try:
+                for i in range(N_ITER):
+                    c.inc(worker=str(tx), shared="all")
+                    c.inc(worker="common", shared=str(i % 5))
+                    h.observe(0.001 * (i % 7), shared=str(i % 3))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(N_THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        for tx in range(N_THREADS):
+            assert c.value(worker=str(tx), shared="all") == N_ITER
+        total_common = sum(c.value(worker="common", shared=str(s))
+                           for s in range(5))
+        assert total_common == N_THREADS * N_ITER
+        total_obs = sum(h.child(shared=str(s)).summary()["count"]
+                        for s in range(3))
+        assert total_obs == N_THREADS * N_ITER
+        # rendering under no lock contention issues
+        samples, _ = parse_prometheus(r.render_prometheus())
+        assert samples[("t_stress_seconds_count", (("shared", "0"),))] > 0
+
+
+class TestDAOMetricsWrapper:
+    def _registry(self):
+        from predictionio_tpu.utils import metrics
+        return metrics
+
+    def test_op_latency_recorded(self):
+        import datetime as dt
+
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.memory import MemLEvents
+        from predictionio_tpu.data.storage.observed import (
+            DAOMetricsWrapper, unwrap,
+        )
+
+        metrics = self._registry()
+        dao = DAOMetricsWrapper(MemLEvents({}), backend="memtest")
+        assert isinstance(unwrap(dao), MemLEvents)
+        before = metrics.STORAGE_OP_LATENCY.child(
+            backend="memtest", op="insert").summary()["count"]
+        eid = dao.insert(Event(event="$set", entity_type="u",
+                               entity_id="1", properties={"a": 1}), 1)
+        assert dao.get(eid, 1) is not None
+        # lazy find is timed through iterator exhaustion
+        assert len(list(dao.find(app_id=1, limit=-1))) == 1
+        after = metrics.STORAGE_OP_LATENCY.child(
+            backend="memtest", op="insert").summary()["count"]
+        assert after == before + 1
+        assert metrics.STORAGE_OP_LATENCY.child(
+            backend="memtest", op="find").summary()["count"] >= 1
+        assert metrics.STORAGE_OP_LATENCY.child(
+            backend="memtest", op="get").summary()["count"] >= 1
+
+    def test_error_counter_on_failing_store(self):
+        from predictionio_tpu.data.storage.memory import MemLEvents
+        from predictionio_tpu.data.storage.observed import DAOMetricsWrapper
+
+        metrics = self._registry()
+
+        class Exploding(MemLEvents):
+            def insert(self, event, app_id, channel_id=None):
+                raise IOError("disk on fire")
+
+            def find(self, *a, **kw):
+                raise RuntimeError("scan failed")
+
+        dao = DAOMetricsWrapper(Exploding({}), backend="failtest")
+        base_ins = metrics.STORAGE_OP_ERRORS.value(
+            backend="failtest", op="insert", error="OSError")
+        base_find = metrics.STORAGE_OP_ERRORS.value(
+            backend="failtest", op="find", error="RuntimeError")
+        with pytest.raises(IOError):
+            dao.insert(object(), 1)
+        with pytest.raises(RuntimeError):
+            dao.find(app_id=1)
+        assert metrics.STORAGE_OP_ERRORS.value(
+            backend="failtest", op="insert",
+            error="OSError") == base_ins + 1
+        assert metrics.STORAGE_OP_ERRORS.value(
+            backend="failtest", op="find",
+            error="RuntimeError") == base_find + 1
+        # failures do not pollute the latency histogram
+        assert metrics.STORAGE_OP_LATENCY.child(
+            backend="failtest", op="insert").summary()["count"] == 0
+
+    def test_registry_wraps_all_levents(self, mem_storage):
+        from predictionio_tpu.data.storage.observed import DAOMetricsWrapper
+
+        le = mem_storage.get_levents()
+        assert isinstance(le, DAOMetricsWrapper)
+        assert le.metrics_backend == "memory"
+
+    def test_passthrough_preserves_backend_internals(self, tmp_path):
+        from predictionio_tpu.data.storage.jsonlfs import JsonlFsLEvents
+        from predictionio_tpu.data.storage.observed import DAOMetricsWrapper
+
+        dao = DAOMetricsWrapper(
+            JsonlFsLEvents({"path": str(tmp_path / "ev")}),
+            backend="jsonlfs")
+        # fast-lane internals and optional ops delegate
+        assert callable(dao._dir) and callable(dao._parts)
+        assert hasattr(dao, "append_raw_lines")
+        # an optional op the backend lacks stays absent through the wrapper
+        from predictionio_tpu.data.storage.memory import MemLEvents
+        mem = DAOMetricsWrapper(MemLEvents({}), backend="memory")
+        assert not hasattr(mem, "append_raw_lines")

@@ -7,7 +7,9 @@ from predictionio_tpu.utils.tracing import LatencyHistogram, profile_trace, span
 
 class TestLatencyHistogram:
     def test_empty(self):
-        assert LatencyHistogram().summary() == {"count": 0}
+        # sumSec is always present so the Prometheus exposition can emit
+        # _sum for a fresh series
+        assert LatencyHistogram().summary() == {"count": 0, "sumSec": 0.0}
 
     def test_quantiles(self):
         h = LatencyHistogram()
