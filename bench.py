@@ -490,6 +490,144 @@ def serving_bench(X: np.ndarray, Y: np.ndarray, n_queries: int = 300,
     }
 
 
+def batchpredict_bench(n_users: int = 2048, n_items: int = 512,
+                       rank: int = 16, chunk: int = 256,
+                       loop_sample: int = 256) -> dict:
+    """Bulk offline scoring (`pio batchpredict`) vs looping the deployed
+    server's single-query serve path over the same queries. Both paths
+    run the SAME loaded engine instance (recommendation template,
+    device-served factors): the looped path pays one device dispatch +
+    fetch per query; the batch engine scores power-of-two chunks through
+    `users_topk` — one dispatch per chunk — and writes restartable
+    JSONL shards (shard + manifest IO included in its number, so the
+    reported speedup is end-to-end honest). Acceptance floor: bulk
+    ≥ 5x looped at this shape."""
+    import os
+    import shutil
+    import tempfile
+
+    import datetime as _dt
+
+    from predictionio_tpu.batch import BatchPredictConfig, BatchPredictor
+    from predictionio_tpu.controller import ComputeContext, EngineParams
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import StorageConfig
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.ops.als import ALSParams
+    from predictionio_tpu.templates.recommendation import (
+        DataSourceParams,
+        engine_factory,
+    )
+    from predictionio_tpu.workflow import run_train
+    from predictionio_tpu.workflow.create_workflow import (
+        WorkflowConfig,
+        new_engine_instance,
+    )
+
+    factory = "predictionio_tpu.templates.recommendation:engine_factory"
+    tmp = tempfile.mkdtemp(prefix="pio_bp_bench_")
+    storage_mod.reset(StorageConfig(
+        sources={"BPB": {"type": "memory"}},
+        repositories={"METADATA": "BPB", "EVENTDATA": "BPB",
+                      "MODELDATA": "BPB"}))
+    prior_backend = os.environ.get("PIO_SERVING_BACKEND")
+    # the bulk-serving shape under test is the device program path
+    # (models past HOST_SERVE_MAX_ELEMS serve there anyway; forcing it
+    # keeps the bench shape-independent)
+    os.environ["PIO_SERVING_BACKEND"] = "device"
+    try:
+        aid = storage_mod.get_metadata_apps().insert(App(0, "bpbench"))
+        le = storage_mod.get_levents()
+        le.init(aid)
+        rng = np.random.default_rng(17)
+        t0 = _dt.datetime(2021, 1, 1, tzinfo=_dt.timezone.utc)
+        item_p = 1.0 / np.arange(1, n_items + 1) ** 0.8
+        item_p /= item_p.sum()
+        CH = 50_000
+        total = n_users * 8
+        for off in range(0, total, CH):
+            m = min(CH, total - off)
+            us = (off + np.arange(m)) // 8
+            its = rng.choice(n_items, size=m, p=item_p)
+            vs = rng.integers(1, 6, size=m)
+            le.insert_batch([
+                Event(event="rate", entity_type="user",
+                      entity_id=f"u{u:06d}", target_entity_type="item",
+                      target_entity_id=f"i{i}",
+                      properties={"rating": float(v)}, event_time=t0)
+                for u, i, v in zip(us, its, vs)], aid)
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="bpbench")),
+            algorithm_params_list=[
+                ("als", ALSParams(rank=rank, num_iterations=2, seed=1))])
+        instance = new_engine_instance(
+            WorkflowConfig(engine_factory=factory), params)
+        t_train = time.perf_counter()
+        iid = run_train(engine_factory(), params, instance,
+                        ctx=ComputeContext())
+        train_sec = time.perf_counter() - t_train
+        assert iid is not None
+
+        queries = [{"user": f"u{u:06d}", "num": 10}
+                   for u in range(n_users)]
+        bp = BatchPredictor(BatchPredictConfig(
+            output_dir=os.path.join(tmp, "out"), engine_instance_id=iid,
+            input_path=os.devnull, chunk_size=chunk))
+        bp.load()  # warm: AOT-compiles single + batched bucket programs
+
+        # looped single-query reference: extraction + predict + wire
+        # render per query — the deployed server's handle_query work,
+        # minus HTTP (the bulk number likewise includes its IO: shard +
+        # manifest writes)
+        import json as _json
+
+        from predictionio_tpu.workflow.create_server import to_jsonable
+
+        sample = queries[:min(loop_sample, len(queries))]
+        for q in sample[:8]:
+            bp.serve_one(q)  # touch every lazy path before timing
+        t0s = time.perf_counter()
+        for q in sample:
+            _json.dumps(to_jsonable(bp.serve_one(q)), sort_keys=True,
+                        separators=(",", ":"))
+        looped_sec = time.perf_counter() - t0s
+        looped_qps = len(sample) / looped_sec
+
+        # bulk: the batch engine end-to-end (chunked device scoring +
+        # shard/manifest writes)
+        qfile = os.path.join(tmp, "queries.jsonl")
+        with open(qfile, "w", encoding="utf-8") as f:
+            for q in queries:
+                f.write(_json.dumps(q) + "\n")
+        bulk = BatchPredictor(BatchPredictConfig(
+            output_dir=os.path.join(tmp, "bulk"),
+            engine_instance_id=iid, input_path=qfile, chunk_size=chunk))
+        summary = bulk.run()
+        bulk_qps = summary["queriesPerSec"]
+        return {
+            "n_users": n_users, "n_items": n_items, "rank": rank,
+            "chunk_size": chunk,
+            "train_sec": round(train_sec, 1),
+            "queries": len(queries),
+            "looped_queries_per_sec": round(looped_qps, 1),
+            "bulk_queries_per_sec": round(bulk_qps, 1),
+            "speedup_vs_looped": round(bulk_qps / looped_qps, 2),
+            "chunks": summary["chunks"],
+            "note": ("both paths serve the same device-resident factors; "
+                     "looped = one dispatch+fetch per query (the REST "
+                     "serve shape), bulk = one users_topk dispatch per "
+                     "power-of-two chunk + restartable shard writes"),
+        }
+    finally:
+        if prior_backend is None:
+            os.environ.pop("PIO_SERVING_BACKEND", None)
+        else:
+            os.environ["PIO_SERVING_BACKEND"] = prior_backend
+        shutil.rmtree(tmp, ignore_errors=True)
+        storage_mod.reset()
+
+
 def instrumentation_overhead_bench(n_requests: int = 400,
                                    rounds: int = 3) -> dict:
     """Observability must never tax the hot path: drive the SAME live
@@ -688,6 +826,10 @@ def main(smoke: bool = False) -> None:
     overhead = instrumentation_overhead_bench(
         n_requests=100 if smoke else 400)
 
+    batchpredict = batchpredict_bench(
+        **({"n_users": 256, "n_items": 128, "chunk": 64,
+            "loop_sample": 64} if smoke else {}))
+
     import jax
 
     headline = {
@@ -717,6 +859,7 @@ def main(smoke: bool = False) -> None:
             "text_classification": text_quality,
             "serving": serving,
             "instrumentation_overhead": overhead,
+            "batchpredict": batchpredict,
         },
     }))
     # compact repeat LAST so a tail-window capture always retains the
@@ -734,6 +877,9 @@ def main(smoke: bool = False) -> None:
         "quality_precision_at_10": quality["precision_at_10"],
         "serving_batched_qps":
             serving["batched"]["queries_per_sec"],
+        "batchpredict_bulk_qps": batchpredict["bulk_queries_per_sec"],
+        "batchpredict_speedup_vs_looped":
+            batchpredict["speedup_vs_looped"],
     }))
 
 

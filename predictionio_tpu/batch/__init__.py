@@ -1,0 +1,29 @@
+"""Batch prediction subsystem (``pio batchpredict``): bulk offline
+scoring through the full DASE serve path in device-shaped, restartable
+chunks. See :mod:`predictionio_tpu.batch.predict`."""
+
+from predictionio_tpu.batch.predict import (
+    BatchPredictConfig,
+    BatchPredictor,
+    Manifest,
+    chunk_spans,
+    input_fingerprint,
+    read_queries_jsonl,
+    read_results,
+    run_batch_predict,
+    run_smoke,
+    synthesize_queries,
+)
+
+__all__ = [
+    "BatchPredictConfig",
+    "BatchPredictor",
+    "Manifest",
+    "chunk_spans",
+    "input_fingerprint",
+    "read_queries_jsonl",
+    "read_results",
+    "run_batch_predict",
+    "run_smoke",
+    "synthesize_queries",
+]

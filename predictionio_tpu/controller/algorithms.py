@@ -31,6 +31,31 @@ from predictionio_tpu.core.base import RETRAIN, BaseAlgorithm, Params
 from predictionio_tpu.core.context import ComputeContext
 
 
+def ordered_batch_results(indexed_queries: Sequence[Tuple[int, Any]],
+                          results: Sequence[Tuple[int, Any]],
+                          who: str = "algorithm") -> List[Any]:
+    """Enforce the ``batch_predict`` contract on a result set: every
+    input query index answered exactly once, nothing extra. Returns the
+    predictions aligned with the input order — the shared validation
+    point for every bulk consumer (evaluation joins per-algorithm
+    predictions itself; the batch-prediction engine and any future bulk
+    path route through here)."""
+    by_qx: dict = {}
+    for qx, p in results:
+        if qx in by_qx:
+            raise RuntimeError(
+                f"{who}.batch_predict answered query {qx} twice")
+        by_qx[qx] = p
+    wanted = [qx for qx, _ in indexed_queries]
+    missing = [qx for qx in wanted if qx not in by_qx]
+    extra = sorted(set(by_qx) - set(wanted))
+    if missing or extra:
+        raise RuntimeError(
+            f"{who}.batch_predict broke the index contract: "
+            f"missing {missing[:5]}, unexpected {extra[:5]}")
+    return [by_qx[qx] for qx in wanted]
+
+
 def _persist_or_model(model: Any, model_id: str, params: Params,
                       ctx: ComputeContext) -> Any:
     """Shared L/P2L persistence decision (LAlgorithm.scala:44-61):

@@ -51,7 +51,13 @@ def _convert(value: Any, typ: Optional[type]) -> Any:
         if isinstance(value, _dt.datetime):
             return value
         if isinstance(value, str):
-            return _dt.datetime.fromisoformat(value)
+            from predictionio_tpu.utils.compat import parse_iso8601
+
+            try:
+                return parse_iso8601(value)
+            except ValueError as e:
+                raise DataMapError(
+                    f"cannot convert {value!r} to datetime") from e
         raise DataMapError(f"cannot convert {value!r} to datetime")
     if isinstance(value, typ):
         return value

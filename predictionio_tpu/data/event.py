@@ -22,6 +22,7 @@ import uuid
 from typing import Any, Mapping, Optional, Sequence, Tuple
 
 from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.utils.compat import parse_iso8601
 
 UTC = _dt.timezone.utc
 
@@ -141,11 +142,8 @@ def _parse_time(v: Any) -> Optional[_dt.datetime]:
         return v if v.tzinfo else v.replace(tzinfo=UTC)
     if isinstance(v, (int, float)):
         return _dt.datetime.fromtimestamp(v / 1000.0, tz=UTC)
-    s = str(v)
-    if s.endswith("Z"):
-        s = s[:-1] + "+00:00"
     try:
-        t = _dt.datetime.fromisoformat(s)
+        t = parse_iso8601(str(v))
     except ValueError as e:
         raise EventValidationError(f"invalid time: {v!r}") from e
     return t if t.tzinfo else t.replace(tzinfo=UTC)

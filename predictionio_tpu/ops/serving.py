@@ -133,11 +133,19 @@ def _normalize_rows(Y):
     return norm(Y)
 
 
-def _bucket(n: int, lo: int = 16) -> int:
+def bucket_size(n: int, lo: int = 16) -> int:
+    """The power-of-two bucket ``n`` rounds up to (min ``lo``). Public:
+    the batch-prediction chunker aligns its chunk sizes to the same
+    buckets `users_topk` dispatches at, so every chunk after the first
+    reuses a compiled program (jit caches stay warm across a whole
+    10M-query job)."""
     b = lo
     while b < n:
         b *= 2
     return b
+
+
+_bucket = bucket_size
 
 
 class HostTopK:

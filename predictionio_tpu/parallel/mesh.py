@@ -8,7 +8,27 @@ dimensions.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
+
+
+def shard_spans(n: int, parts: int) -> List[Tuple[int, int]]:
+    """Split ``range(n)`` into at most ``parts`` balanced contiguous
+    ``(start, stop)`` spans, never emitting an empty span — the
+    map-over-shards index math (the DrJAX idiom: a fixed partition of
+    the workload mapped over devices/chunks). Used by the
+    batch-prediction chunker (``--query-partitions``) and reusable for
+    per-device work assignment."""
+    if n <= 0:
+        return []
+    parts = max(1, min(int(parts), n))
+    base, rem = divmod(n, parts)
+    spans: List[Tuple[int, int]] = []
+    start = 0
+    for i in range(parts):
+        stop = start + base + (1 if i < rem else 0)
+        spans.append((start, stop))
+        start = stop
+    return spans
 
 
 def data_parallel_mesh(n_devices: Optional[int] = None,

@@ -20,6 +20,7 @@ cleanly into the Models repository (persistence mode 1).
 from __future__ import annotations
 
 import dataclasses
+import math
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -510,9 +511,11 @@ def _coerce_query(query: Any) -> Query:
 def _winners_to_result(idx, scores, black, num: int,
                        item_map: StringIndexBiMap) -> PredictedResult:
     """Fetched top-k row -> PredictedResult: drop blacklisted, non-finite
-    and non-positive scores host-side, clip to num."""
+    and non-positive scores host-side, clip to num. ``math.isfinite`` on
+    the python floats, not ``np.isfinite`` per element — this runs once
+    per query of a bulk batch-predict job."""
     keep = [(i, s) for i, s in zip(idx.tolist(), scores.tolist())
-            if i not in black and np.isfinite(s) and s > 0][:num]
+            if i not in black and math.isfinite(s) and s > 0][:num]
     if not keep:
         return PredictedResult(())
     items = item_map.decode(np.asarray([i for i, _ in keep],

@@ -201,6 +201,47 @@ def build_parser() -> argparse.ArgumentParser:
     _add_metrics_arg(dep)
     dep.set_defaults(func=run_commands.cmd_deploy)
 
+    bp = sub.add_parser(
+        "batchpredict",
+        help="bulk offline scoring: run a query file (or every known "
+             "entity) through a trained engine instance in restartable "
+             "device-shaped chunks")
+    _add_engine_args(bp)
+    bp.add_argument("--engine-instance-id", default=None)
+    bp.add_argument("--input", default=None,
+                    help="JSONL query file (one query object per line, "
+                         "the /queries.json wire format)")
+    bp.add_argument("--output", default=None,
+                    help="output directory: per-chunk shard files + "
+                         "manifest.json (reruns resume from it)")
+    bp.add_argument("--query-partitions", type=int, default=None,
+                    help="split the queries into N balanced partitions "
+                         "(default: fixed --chunk-size chunks)")
+    bp.add_argument("--chunk-size", type=int, default=256,
+                    help="queries per chunk (power-of-two aligned to the "
+                         "serving buckets; default 256)")
+    bp.add_argument("--format", choices=("jsonl", "npz"), default="jsonl",
+                    help="shard format: jsonl (default) or columnar npz")
+    bp.add_argument("--synthesize-app", default=None, metavar="APP",
+                    help="instead of --input: one query per known entity "
+                         "of APP (via the materialized aggregation)")
+    bp.add_argument("--synthesize-entity-type", default="user")
+    bp.add_argument("--synthesize-field", default="user",
+                    help="query field receiving the entity id "
+                         "(default 'user')")
+    bp.add_argument("--synthesize-base", default="{}", metavar="JSON",
+                    help="JSON object merged into every synthesized "
+                         "query (e.g. '{\"num\": 10}')")
+    bp.add_argument("--channel", default=None,
+                    help="channel for --synthesize-app")
+    bp.add_argument("--batch", default="")
+    bp.add_argument("--smoke", action="store_true",
+                    help="self-contained CPU smoke: seed + train a tiny "
+                         "engine in memory, batch-predict, crash, resume "
+                         "and verify — ignores the other flags")
+    _add_metrics_arg(bp)
+    bp.set_defaults(func=run_commands.cmd_batchpredict)
+
     undep = sub.add_parser("undeploy", help="stop a deployed engine server")
     undep.add_argument("--ip", default="0.0.0.0")
     undep.add_argument("--port", type=int, default=8000)

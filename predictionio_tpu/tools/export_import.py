@@ -94,18 +94,22 @@ def export_events(output: str, app_name: Optional[str] = None,
 
 
 def _dict_encode(values) -> tuple:
-    """list of str|None -> (codes int32 with -1 = None, labels)."""
-    arr = np.asarray([v if v is not None else "\0N" for v in values],
-                     dtype=np.str_)
-    labels, codes = np.unique(arr, return_inverse=True)
-    codes = codes.astype(np.int32)
-    none_pos = np.nonzero(labels == "\0N")[0]
-    if len(none_pos):
-        # remap the sentinel label to code -1 and drop it from labels
-        sent = int(none_pos[0])
-        codes = np.where(codes == sent, -1,
-                         codes - (codes > sent).astype(np.int32))
-        labels = np.delete(labels, sent)
+    """list of str|None -> (codes int32 with -1 = None, labels).
+
+    Nulls are tracked OUT-OF-BAND (a boolean mask over the input), never
+    as an in-band sentinel string: only genuinely non-null values reach
+    the label table, so a real value equal to any would-be sentinel
+    (e.g. the literal string ``"\\0N"``) round-trips intact."""
+    null = np.fromiter((v is None for v in values), dtype=bool,
+                       count=len(values))
+    codes = np.full(len(values), -1, dtype=np.int32)
+    present = [v for v in values if v is not None]
+    if present:
+        labels, pcodes = np.unique(np.asarray(present, dtype=np.str_),
+                                   return_inverse=True)
+        codes[~null] = pcodes.astype(np.int32)
+    else:
+        labels = np.empty(0, dtype=np.str_)
     return codes, labels
 
 

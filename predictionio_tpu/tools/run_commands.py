@@ -214,6 +214,64 @@ def cmd_deploy(args) -> int:
     return 0
 
 
+def cmd_batchpredict(args) -> int:
+    """Bulk offline scoring (the later releases' ``pio batchpredict``):
+    queries from a JSONL file or synthesized from the event store, run
+    through the full DASE serve path in restartable device-shaped
+    chunks. See predictionio_tpu/batch/predict.py."""
+    from predictionio_tpu.batch import (
+        BatchPredictConfig,
+        run_batch_predict,
+        run_smoke,
+    )
+
+    _apply_metrics_flag(args)
+    if args.smoke:
+        return run_smoke()
+    if not args.output:
+        print("[ERROR] --output is required (the shard/manifest "
+              "directory).", file=sys.stderr)
+        return 1
+    try:
+        base = json.loads(args.synthesize_base or "{}")
+        if not isinstance(base, dict):
+            raise ValueError("--synthesize-base must be a JSON object")
+        variant_id, variant_version = "default", "default"
+        if os.path.exists(args.engine_variant):
+            variant = _load_variant(args.engine_variant)
+            variant_id = variant.get("id", "default")
+            variant_version = variant.get("version", "default")
+        config = BatchPredictConfig(
+            output_dir=args.output,
+            engine_instance_id=args.engine_instance_id,
+            engine_id=getattr(args, "engine_id", None) or variant_id,
+            engine_version=(getattr(args, "engine_version", None)
+                            or variant_version),
+            engine_variant=args.engine_variant,
+            input_path=args.input,
+            synthesize_app=args.synthesize_app,
+            synthesize_entity_type=args.synthesize_entity_type,
+            synthesize_field=args.synthesize_field,
+            synthesize_base=base,
+            synthesize_channel=args.channel,
+            chunk_size=args.chunk_size,
+            query_partitions=args.query_partitions,
+            format=args.format,
+            batch=getattr(args, "batch", "") or "",
+        )
+        summary = run_batch_predict(config)
+    except Exception as e:
+        print(f"[ERROR] Batch predict failed: {e}", file=sys.stderr)
+        return 1
+    print(f"[INFO] Batch predict completed: {summary['queries']} queries "
+          f"in {summary['chunks']} chunks "
+          f"({summary['chunksScored']} scored, "
+          f"{summary['chunksSkipped']} resumed) -> "
+          f"{summary['outputDir']} "
+          f"[{summary['queriesPerSec']} q/s scoring]")
+    return 0
+
+
 def cmd_undeploy(args) -> int:
     """Console undeploy (Console.scala:880-890): stop a running server.
     Probes HTTP first, then HTTPS, so it stops servers deployed with a

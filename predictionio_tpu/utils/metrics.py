@@ -515,6 +515,19 @@ AGGREGATE_SCOPE_DROPS = REGISTRY.counter(
     "bulk deletes, app removals)",
     ("backend",))
 
+# -- batch prediction ------------------------------------------------------
+BATCHPREDICT_QUERIES = REGISTRY.counter(
+    "pio_batchpredict_queries_total",
+    "Batch-prediction queries by outcome (scored = computed this run; "
+    "skipped = chunk already complete in the manifest)",
+    ("status",))
+BATCHPREDICT_CHUNK_LATENCY = REGISTRY.histogram(
+    "pio_batchpredict_chunk_seconds",
+    "Wall time to score and persist one batch-prediction chunk")
+BATCHPREDICT_QPS = REGISTRY.gauge(
+    "pio_batchpredict_queries_per_sec",
+    "Scoring throughput of the most recent batch-prediction run")
+
 # -- training workflow -----------------------------------------------------
 TRAIN_STAGE_LATENCY = REGISTRY.histogram(
     "pio_train_stage_seconds",

@@ -116,12 +116,19 @@ def engine_instance_to_engine_params(
     )
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
 def _camel(name: str) -> str:
     head, *rest = name.split("_")
     return head + "".join(w.capitalize() for w in rest)
 
 
+@functools.lru_cache(maxsize=4096)
 def _snake(name: str) -> str:
+    """camelCase -> snake_case, cached: the same handful of field names
+    recurs for every query of a bulk job."""
     out = []
     for ch in name:
         if ch.isupper():
@@ -132,13 +139,41 @@ def _snake(name: str) -> str:
     return "".join(out)
 
 
+_FIELD_CACHE: Dict[type, List[Tuple[str, str]]] = {}
+
+
+def _fields_camel(cls: type) -> List[Tuple[str, str]]:
+    """(snake field name, camel wire name) pairs per dataclass, cached —
+    ``dataclasses.fields`` introspection per OBJECT made serialization
+    the hottest line of bulk prediction (one call per nested score)."""
+    cached = _FIELD_CACHE.get(cls)
+    if cached is None:
+        cached = [(f.name, _camel(f.name))
+                  for f in dataclasses.fields(cls)]
+        _FIELD_CACHE[cls] = cached
+    return cached
+
+
 def to_jsonable(obj: Any) -> Any:
     """Prediction/query → wire JSON. Dataclass fields go out camelCased
-    (itemScores), matching the reference's case-class serialization style."""
+    (itemScores), matching the reference's case-class serialization style.
+
+    Leaf scalars (every score/item string of a bulk top-K job) exit on
+    the first check — the ABC ``Mapping`` isinstance they used to fall
+    through was a measurable slice of batch-prediction wall time."""
+    t = type(obj)
+    if t is str or t is float or t is int or t is bool or obj is None:
+        return obj
+    if t is list or t is tuple:
+        return [to_jsonable(v) for v in obj]
+    cached = _FIELD_CACHE.get(t)
+    if cached is not None:  # a dataclass seen before: skip introspection
+        return {camel: to_jsonable(getattr(obj, name))
+                for name, camel in cached}
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {
-            _camel(f.name): to_jsonable(getattr(obj, f.name))
-            for f in dataclasses.fields(obj)
+            camel: to_jsonable(getattr(obj, name))
+            for name, camel in _fields_camel(t)
         }
     if isinstance(obj, np.ndarray):
         return obj.tolist()
@@ -153,24 +188,34 @@ def to_jsonable(obj: Any) -> Any:
     return obj
 
 
+_QUERY_FIELDS: Dict[type, Tuple[str, ...]] = {}
+
+
 def query_from_json(query_dict: Mapping[str, Any],
                     query_cls: Optional[type]) -> Any:
     """Typed-query extraction (JsonExtractor.extract analog): camelCase
     keys map onto the dataclass's snake_case fields; unknown/missing keys
-    are explicit errors → 400."""
+    are explicit errors → 400. Field tables are cached per query class —
+    this runs once per query of a bulk batch-predict job."""
     if query_cls is None or not dataclasses.is_dataclass(query_cls):
         return dict(query_dict)
+    names = _QUERY_FIELDS.get(query_cls)
+    if names is None:
+        names = tuple(f.name for f in dataclasses.fields(query_cls))
+        _QUERY_FIELDS[query_cls] = names
     data = {_snake(k): v for k, v in query_dict.items()}
-    fields = {f.name: f for f in dataclasses.fields(query_cls)}
-    for name, f in fields.items():
+    for name in names:
         # JSON arrays -> tuple fields
-        if name in data and isinstance(data[name], list):
+        if name in data and type(data[name]) is list:
             data[name] = tuple(data[name])
     return params_from_dict(query_cls, data, where=query_cls.__name__)
 
 
-class _Deployment:
-    """One immutable deployed engine state; swapped atomically on reload."""
+class Deployment:
+    """One immutable deployed engine state; swapped atomically on reload.
+    Shared by the query server and the batch-prediction engine
+    (``predictionio_tpu/batch``) — both serve through the same loaded
+    DASE state."""
 
     def __init__(self, instance: EngineInstance, engine: Engine,
                  engine_params: EngineParams, algorithms: List[Any],
@@ -182,6 +227,119 @@ class _Deployment:
         self.models = models
         self.serving = serving
         self.start_time = _dt.datetime.now(tz=UTC)
+
+
+_Deployment = Deployment  # backwards-compatible private alias
+
+
+def resolve_engine_instance(engine_instance_id: Optional[str],
+                            engine_id: str = "default",
+                            engine_version: str = "default",
+                            engine_variant: str = "engine.json"
+                            ) -> EngineInstance:
+    """The given instance, or the latest COMPLETED one for the engine
+    coordinates (CreateServer.scala:148-211 resolution order)."""
+    instances = storage.get_metadata_engine_instances()
+    if engine_instance_id:
+        instance = instances.get(engine_instance_id)
+        if instance is None:
+            raise StorageError(
+                f"engine instance {engine_instance_id!r} not found")
+        return instance
+    instance = instances.get_latest_completed(
+        engine_id, engine_version, engine_variant)
+    if instance is None:
+        raise StorageError(
+            "No valid engine instance found for engine "
+            f"{engine_id} {engine_version} {engine_variant}. "
+            "Try running train first.")
+    return instance
+
+
+def build_deployment(instance: EngineInstance, ctx: ComputeContext,
+                     engine: Optional[Engine] = None,
+                     batch: str = "") -> Deployment:
+    """Load one engine instance into servable state
+    (createServerActorWithEngine, CreateServer.scala:213-272): rebuild
+    EngineParams from the params snapshot, deserialize + prepare_deploy
+    the persisted models, validate the ensemble's query typing, and
+    instantiate serving. Warm-up is the caller's choice (``warm_up``)."""
+    if engine is None:
+        factory = core_workflow.load_engine_factory(instance.engine_factory)
+        engine = factory()
+        from predictionio_tpu.controller.evaluation import Evaluation
+        if isinstance(engine, Evaluation):
+            engine = engine.engine
+    engine_params = engine_instance_to_engine_params(engine, instance)
+
+    blob = storage.get_model_data_models().get(instance.id)
+    if blob is None:
+        raise StorageError(
+            f"no persisted models for engine instance {instance.id}")
+    persisted = core_workflow.deserialize_models(blob.models)
+    models = engine.prepare_deploy(
+        ctx, engine_params, instance.id, persisted,
+        params=WorkflowParams(batch=batch))
+
+    algorithms = engine._algorithms(engine_params)
+    # every ensemble member must agree on the query type: queries are
+    # extracted with algorithms[0].query_class and fed to ALL of them
+    # (CreateServer.scala:519-525 likewise types the whole server by
+    # the first algorithm) — a silent mismatch would crash or
+    # mis-parse at query time, so refuse at load
+    declared = {a.query_class for a in algorithms
+                if a.query_class is not None}
+    if len(declared) > 1:
+        names = sorted(c.__name__ for c in declared)
+        raise ValueError(
+            f"algorithms declare different query classes {names}; an "
+            "ensemble must share one query type (the server extracts "
+            "queries with the first algorithm's class)")
+    if declared and algorithms[0].query_class is None:
+        # a typed member behind an untyped first algorithm would
+        # receive raw dicts — the same silent mismatch
+        raise ValueError(
+            f"algorithm {type(algorithms[0]).__name__} declares no "
+            f"query class but a later ensemble member expects "
+            f"{next(iter(declared)).__name__}; the first algorithm "
+            "types query extraction for the whole server")
+    sv_name, sv_params = engine_params.serving_params
+    serving = engine._make(engine.serving_class_map, sv_name, sv_params,
+                           "serving")
+    return Deployment(instance, engine, engine_params, algorithms,
+                      models, serving)
+
+
+def warm_up(dep: Deployment,
+            warmup_query: Optional[Mapping[str, Any]] = None) -> None:
+    """AOT-compile the predict path before the first real query (SURVEY
+    hard part #4): per-algorithm ``warmup_base`` hooks, then an optional
+    sacrificial query through the full serve path."""
+    for algo, model in zip(dep.algorithms, dep.models):
+        warmup = getattr(algo, "warmup_base", None)
+        if callable(warmup):
+            try:
+                warmup(model)
+            except Exception:
+                logger.exception("warmup_base failed (non-fatal)")
+    if warmup_query is not None:
+        try:
+            query = query_from_json(dict(warmup_query),
+                                    dep.algorithms[0].query_class)
+            serve_query(dep, query)
+        except Exception:
+            logger.exception("warmup query failed (non-fatal)")
+
+
+def serve_query(dep: Deployment, query: Any) -> Any:
+    """The single-query DASE serve path: supplement → predict per
+    algorithm → serve with the ORIGINAL query (scala :538-540)."""
+    supplemented = dep.serving.supplement_base(query)
+    predictions = [
+        algo.predict_base(model, supplemented)
+        for algo, model in zip(dep.algorithms, dep.models)
+    ]
+    return dep.serving.serve_base(query, predictions)
 
 
 class QueryServer:
@@ -208,23 +366,9 @@ class QueryServer:
 
     # -- deploy ------------------------------------------------------------
     def _resolve_instance(self) -> EngineInstance:
-        instances = storage.get_metadata_engine_instances()
-        if self.config.engine_instance_id:
-            instance = instances.get(self.config.engine_instance_id)
-            if instance is None:
-                raise StorageError(
-                    f"engine instance {self.config.engine_instance_id!r} "
-                    "not found")
-            return instance
-        instance = instances.get_latest_completed(
-            self.config.engine_id, self.config.engine_version,
-            self.config.engine_variant)
-        if instance is None:
-            raise StorageError(
-                "No valid engine instance found for engine "
-                f"{self.config.engine_id} {self.config.engine_version} "
-                f"{self.config.engine_variant}. Try running train first.")
-        return instance
+        return resolve_engine_instance(
+            self.config.engine_instance_id, self.config.engine_id,
+            self.config.engine_version, self.config.engine_variant)
 
     def deploy(self) -> "QueryServer":
         """Load + warm the engine (createServerActorWithEngine,
@@ -234,71 +378,16 @@ class QueryServer:
         logger.info("Engine instance %s deployed", instance.id)
         return self
 
-    def _build_deployment(self, instance: EngineInstance) -> _Deployment:
-        if self._engine_override is not None:
-            engine = self._engine_override
-        else:
-            factory = core_workflow.load_engine_factory(
-                instance.engine_factory)
-            engine = factory()
-            from predictionio_tpu.controller.evaluation import Evaluation
-            if isinstance(engine, Evaluation):
-                engine = engine.engine
-        engine_params = engine_instance_to_engine_params(engine, instance)
-
-        blob = storage.get_model_data_models().get(instance.id)
-        if blob is None:
-            raise StorageError(
-                f"no persisted models for engine instance {instance.id}")
-        persisted = core_workflow.deserialize_models(blob.models)
-        models = engine.prepare_deploy(
-            self.ctx, engine_params, instance.id, persisted,
-            params=WorkflowParams(batch=self.config.batch))
-
-        algorithms = engine._algorithms(engine_params)
-        # every ensemble member must agree on the query type: queries are
-        # extracted with algorithms[0].query_class and fed to ALL of them
-        # (CreateServer.scala:519-525 likewise types the whole server by
-        # the first algorithm) — a silent mismatch would crash or
-        # mis-parse at query time, so refuse at deploy
-        declared = {a.query_class for a in algorithms
-                    if a.query_class is not None}
-        if len(declared) > 1:
-            names = sorted(c.__name__ for c in declared)
-            raise ValueError(
-                f"algorithms declare different query classes {names}; an "
-                "ensemble must share one query type (the server extracts "
-                "queries with the first algorithm's class)")
-        if declared and algorithms[0].query_class is None:
-            # a typed member behind an untyped first algorithm would
-            # receive raw dicts — the same silent mismatch
-            raise ValueError(
-                f"algorithm {type(algorithms[0]).__name__} declares no "
-                f"query class but a later ensemble member expects "
-                f"{next(iter(declared)).__name__}; the first algorithm "
-                "types query extraction for the whole server")
-        sv_name, sv_params = engine_params.serving_params
-        serving = engine._make(engine.serving_class_map, sv_name, sv_params,
-                               "serving")
-        dep = _Deployment(instance, engine, engine_params, algorithms,
-                          models, serving)
+    def _build_deployment(self, instance: EngineInstance) -> Deployment:
+        dep = build_deployment(instance, self.ctx,
+                               engine=self._engine_override,
+                               batch=self.config.batch)
         self._warm_up(dep)
         return dep
 
-    def _warm_up(self, dep: _Deployment) -> None:
+    def _warm_up(self, dep: Deployment) -> None:
         """AOT-compile the predict path before the first real query."""
-        for algo, model in zip(dep.algorithms, dep.models):
-            warmup = getattr(algo, "warmup_base", None)
-            if callable(warmup):
-                try:
-                    warmup(model)
-                except Exception:
-                    logger.exception("warmup_base failed (non-fatal)")
-        if self.config.warmup_query is not None:
-            try:
-                self._serve_one(dep, dict(self.config.warmup_query))
-            except Exception:
-                logger.exception("warmup query failed (non-fatal)")
+        warm_up(dep, self.config.warmup_query)
 
     # -- the query path (CreateServer.scala:510-661) -----------------------
     def _serve_one(self, dep: _Deployment,
@@ -307,14 +396,9 @@ class QueryServer:
         return query, self._predict(dep, query)
 
     @staticmethod
-    def _predict(dep: _Deployment, query: Any) -> Any:
-        supplemented = dep.serving.supplement_base(query)
-        predictions = [
-            algo.predict_base(model, supplemented)
-            for algo, model in zip(dep.algorithms, dep.models)
-        ]
+    def _predict(dep: Deployment, query: Any) -> Any:
         # by design: serve with the *original* query (scala :538-540)
-        return dep.serving.serve_base(query, predictions)
+        return serve_query(dep, query)
 
     @staticmethod
     def _extract_query(dep: _Deployment,

@@ -1,0 +1,521 @@
+"""Batch-prediction subsystem tests (``pio batchpredict``).
+
+- chunk planning / fingerprint / manifest mechanics
+- for THREE templates (recommendation, similarproduct, classification):
+  chunked batch output is byte-identical to looping the single-query
+  serve path over the same queries
+- crash-resume: a run killed after K chunks (fault injection) resumes —
+  completed shards keep their checksums (not re-scored) and the final
+  output equals a clean single-pass run; torn shards are re-scored
+- query synthesis from the event store (one query per known entity)
+- both output formats (jsonl / columnar npz) agree
+- CLI wiring + a slow-marked larger e2e
+"""
+
+import dataclasses
+import datetime as dt
+import json
+import os
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.batch import (
+    BatchPredictConfig,
+    BatchPredictor,
+    Manifest,
+    chunk_spans,
+    input_fingerprint,
+    read_results,
+    run_batch_predict,
+    synthesize_queries,
+)
+from predictionio_tpu.batch.predict import MANIFEST_NAME
+from predictionio_tpu.controller import ComputeContext, EngineParams
+from predictionio_tpu.controller.algorithms import ordered_batch_results
+from predictionio_tpu.data import storage
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.ops.als import ALSParams
+from predictionio_tpu.parallel.mesh import shard_spans
+from predictionio_tpu.workflow import run_train
+from predictionio_tpu.workflow.create_server import to_jsonable
+from predictionio_tpu.workflow.create_workflow import (
+    WorkflowConfig,
+    new_engine_instance,
+)
+
+UTC = dt.timezone.utc
+CTX = ComputeContext()
+T0 = dt.datetime(2021, 1, 1, tzinfo=UTC)
+
+
+# ---------------------------------------------------------------------------
+# Seeding + training helpers (one per template)
+# ---------------------------------------------------------------------------
+
+def _new_app(name):
+    aid = storage.get_metadata_apps().insert(App(0, name))
+    le = storage.get_levents()
+    le.init(aid)
+    return aid, le
+
+
+def _train(factory_path, params):
+    from predictionio_tpu.workflow.core_workflow import load_engine_factory
+
+    engine = load_engine_factory(factory_path)()
+    instance = new_engine_instance(
+        WorkflowConfig(engine_factory=factory_path), params)
+    iid = run_train(engine, params, instance, ctx=CTX)
+    assert iid is not None
+    return iid
+
+
+def seed_recommendation(app="bprec"):
+    from predictionio_tpu.templates.recommendation import DataSourceParams
+
+    aid, le = _new_app(app)
+    rng = np.random.default_rng(0)
+    events = [Event(event="$set", entity_type="user", entity_id=f"u{u:02d}",
+                    properties={"active": True}, event_time=T0)
+              for u in range(20)]
+    for u in range(20):
+        group = "a" if u < 10 else "b"
+        for _ in range(8):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u:02d}",
+                target_entity_type="item",
+                target_entity_id=f"{group}{rng.integers(0, 10)}",
+                properties={"rating": float(rng.integers(4, 6))},
+                event_time=T0))
+    le.insert_batch(events, aid)
+    params = EngineParams(
+        data_source_params=("", DataSourceParams(app_name=app)),
+        algorithm_params_list=[
+            ("als", ALSParams(rank=8, num_iterations=3, seed=0))])
+    iid = _train(
+        "predictionio_tpu.templates.recommendation:engine_factory", params)
+    queries = [{"user": f"u{u:02d}", "num": 3} for u in range(20)] \
+        + [{"user": "ghost", "num": 3},
+           {"items": ["a1", "a2"], "num": 4}]
+    return iid, queries
+
+
+def seed_similarproduct(app="bpsim"):
+    from predictionio_tpu.templates.similarproduct import DataSourceParams
+
+    aid, le = _new_app(app)
+    rng = np.random.default_rng(1)
+    events = []
+    for u in range(12):
+        events.append(Event(event="$set", entity_type="user",
+                            entity_id=f"u{u}", event_time=T0))
+    for i in range(10):
+        events.append(Event(event="$set", entity_type="item",
+                            entity_id=f"i{i}",
+                            properties={"categories": ["c1" if i < 5
+                                                       else "c2"]},
+                            event_time=T0))
+    for u in range(12):
+        base = 0 if u < 6 else 5
+        for _ in range(6):
+            events.append(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{base + rng.integers(0, 5)}",
+                event_time=T0))
+    le.insert_batch(events, aid)
+    params = EngineParams(
+        data_source_params=("", DataSourceParams(app_name=app)))
+    algo_params = [("als", None)]
+    from predictionio_tpu.templates.similarproduct import (
+        ALSAlgorithmParams,
+    )
+    params = dataclasses.replace(params, algorithm_params_list=[
+        ("als", ALSAlgorithmParams(rank=6, num_iterations=3, seed=0))])
+    del algo_params
+    iid = _train(
+        "predictionio_tpu.templates.similarproduct:engine_factory", params)
+    queries = [{"items": [f"i{i}"], "num": 3} for i in range(10)] \
+        + [{"items": ["i0", "i1"], "num": 2, "categories": ["c1"]}]
+    return iid, queries
+
+
+def seed_classification(app="bpcls"):
+    from predictionio_tpu.templates.classification import DataSourceParams
+
+    aid, le = _new_app(app)
+    rng = np.random.default_rng(2)
+    events = []
+    for u in range(30):
+        label = float(u % 3)
+        feats = (rng.integers(0, 5, size=3)
+                 + np.array([3, 0, 0]) * (label == 0)
+                 + np.array([0, 3, 0]) * (label == 1))
+        events.append(Event(
+            event="$set", entity_type="user", entity_id=f"u{u}",
+            properties={"plan": label, "attr0": float(feats[0]),
+                        "attr1": float(feats[1]),
+                        "attr2": float(feats[2])},
+            event_time=T0))
+    le.insert_batch(events, aid)
+    from predictionio_tpu.templates.classification import NaiveBayesParams
+
+    params = EngineParams(
+        data_source_params=("", DataSourceParams(app_name=app)),
+        algorithm_params_list=[("naive", NaiveBayesParams())])
+    iid = _train(
+        "predictionio_tpu.templates.classification:engine_factory", params)
+    queries = [{"features": [float(a), float(b), 1.0]}
+               for a in range(4) for b in range(3)]
+    return iid, queries
+
+
+def _write_queries(tmp_path, queries, name="queries.jsonl"):
+    path = str(tmp_path / name)
+    with open(path, "w", encoding="utf-8") as f:
+        for q in queries:
+            f.write(json.dumps(q) + "\n")
+    return path
+
+
+def _shard_bytes(out_dir):
+    """Concatenated shard-file content in chunk order."""
+    manifest = Manifest.load(os.path.join(out_dir, MANIFEST_NAME))
+    blobs = []
+    for chunk in manifest.chunks:
+        with open(os.path.join(out_dir, chunk["file"]), "rb") as f:
+            blobs.append(f.read())
+    return b"".join(blobs)
+
+
+# ---------------------------------------------------------------------------
+# Mechanics
+# ---------------------------------------------------------------------------
+
+class TestChunkPlanning:
+    def test_shard_spans_balanced(self):
+        assert shard_spans(10, 3) == [(0, 4), (4, 7), (7, 10)]
+        assert shard_spans(2, 5) == [(0, 1), (1, 2)]  # never empty spans
+        assert shard_spans(0, 3) == []
+        spans = shard_spans(1000, 7)
+        assert spans[0][0] == 0 and spans[-1][1] == 1000
+        assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
+
+    def test_chunk_spans_power_of_two_aligned(self):
+        spans = chunk_spans(1000, 100)  # 100 -> bucket 128
+        assert spans[0] == (0, 128)
+        assert spans[-1][1] == 1000
+        assert chunk_spans(5, 256) == [(0, 5)]
+        assert chunk_spans(20, 8, query_partitions=2) == [(0, 10), (10, 20)]
+
+    def test_fingerprint_sensitivity(self):
+        a = input_fingerprint(['{"user":"u1"}', '{"user":"u2"}'])
+        b = input_fingerprint(['{"user":"u1"}', '{"user":"u3"}'])
+        c = input_fingerprint(['{"user":"u1"}{"user":"u2"}'])
+        assert a != b and a != c
+        assert a == input_fingerprint(['{"user":"u1"}', '{"user":"u2"}'])
+
+    def test_ordered_batch_results_contract(self):
+        indexed = [(0, "a"), (1, "b")]
+        assert ordered_batch_results(indexed, [(1, "B"), (0, "A")]) \
+            == ["A", "B"]
+        with pytest.raises(RuntimeError, match="twice"):
+            ordered_batch_results(indexed, [(0, "A"), (0, "A2")])
+        with pytest.raises(RuntimeError, match="index contract"):
+            ordered_batch_results(indexed, [(0, "A")])
+        with pytest.raises(RuntimeError, match="index contract"):
+            ordered_batch_results(indexed, [(0, "A"), (1, "B"), (7, "X")])
+
+    def test_config_requires_one_source(self, tmp_path):
+        with pytest.raises(ValueError, match="exactly one query source"):
+            BatchPredictor(BatchPredictConfig(output_dir=str(tmp_path)))
+        with pytest.raises(ValueError, match="exactly one query source"):
+            BatchPredictor(BatchPredictConfig(
+                output_dir=str(tmp_path), input_path="x",
+                synthesize_app="y"))
+        with pytest.raises(ValueError, match="unknown output format"):
+            BatchPredictor(BatchPredictConfig(
+                output_dir=str(tmp_path), input_path="x",
+                format="parquet"))
+
+
+# ---------------------------------------------------------------------------
+# Byte-identical vs the looped single-query serve path, three templates
+# ---------------------------------------------------------------------------
+
+class TestTemplateParity:
+    @pytest.mark.parametrize("seeder", [seed_recommendation,
+                                        seed_similarproduct,
+                                        seed_classification])
+    def test_batch_equals_looped_single_query(self, mem_storage, tmp_path,
+                                              seeder):
+        iid, queries = seeder()
+        qfile = _write_queries(tmp_path, queries)
+        out = str(tmp_path / "out")
+        config = BatchPredictConfig(
+            output_dir=out, engine_instance_id=iid, input_path=qfile,
+            chunk_size=8)
+        summary = run_batch_predict(config)
+        assert summary["queries"] == len(queries)
+        assert summary["chunksScored"] == summary["chunks"]
+
+        # the reference: loop every query through the single-query DASE
+        # serve path (what the deployed REST server runs per request)
+        bp = BatchPredictor(dataclasses.replace(
+            config, output_dir=str(tmp_path / "probe")))
+        from predictionio_tpu.batch.predict import (
+            _canonical_query_lines,
+        )
+        lines = _canonical_query_lines(queries)
+        looped = [bp.serve_one(q) for q in queries]
+        expected = b"".join(
+            (rec + "\n").encode("utf-8")
+            for rec in BatchPredictor._render_records(lines, looped))
+        assert _shard_bytes(out) == expected  # byte-identical
+
+    def test_results_read_back_in_order(self, mem_storage, tmp_path):
+        iid, queries = seed_recommendation()
+        qfile = _write_queries(tmp_path, queries)
+        out = str(tmp_path / "out")
+        run_batch_predict(BatchPredictConfig(
+            output_dir=out, engine_instance_id=iid, input_path=qfile,
+            chunk_size=8))
+        results = read_results(out)
+        assert [r["query"] for r in results] == queries
+        # known users get scored items; the unknown user gets none
+        assert results[0]["prediction"]["itemScores"]
+        ghost = next(r for r in results if r["query"]["user"] == "ghost")
+        assert ghost["prediction"]["itemScores"] == []
+
+
+# ---------------------------------------------------------------------------
+# Crash-resume
+# ---------------------------------------------------------------------------
+
+class TestCrashResume:
+    def test_killed_run_resumes_without_rescoring(self, mem_storage,
+                                                  tmp_path):
+        iid, queries = seed_recommendation()
+        qfile = _write_queries(tmp_path, queries)
+        clean_dir = str(tmp_path / "clean")
+        resumed_dir = str(tmp_path / "resumed")
+
+        def config(out, **kw):
+            return BatchPredictConfig(
+                output_dir=out, engine_instance_id=iid, input_path=qfile,
+                chunk_size=8, **kw)
+
+        run_batch_predict(config(clean_dir))
+        # kill after 1 chunk (fault-injection hook = the mid-run crash)
+        with pytest.raises(RuntimeError, match="fault injection"):
+            run_batch_predict(config(resumed_dir, fail_after_chunks=1))
+        partial = Manifest.load(os.path.join(resumed_dir, MANIFEST_NAME))
+        done = {c["id"]: c["sha256"] for c in partial.chunks
+                if c["status"] == "done"}
+        assert len(done) == 1
+        assert any(c["status"] == "pending" for c in partial.chunks)
+
+        summary = run_batch_predict(config(resumed_dir))
+        assert summary["chunksSkipped"] == 1
+        assert summary["chunksScored"] == summary["chunks"] - 1
+        after = Manifest.load(os.path.join(resumed_dir, MANIFEST_NAME))
+        for c in after.chunks:
+            if c["id"] in done:  # completed chunks were NOT re-scored
+                assert c["sha256"] == done[c["id"]]
+        # final output equals the clean single-pass run, byte for byte
+        assert _shard_bytes(resumed_dir) == _shard_bytes(clean_dir)
+
+        # a fully-complete rerun is a no-op
+        summary = run_batch_predict(config(resumed_dir))
+        assert summary["chunksScored"] == 0
+        assert summary["chunksSkipped"] == summary["chunks"]
+
+    def test_torn_shard_is_rescored(self, mem_storage, tmp_path):
+        iid, queries = seed_recommendation()
+        qfile = _write_queries(tmp_path, queries)
+        out = str(tmp_path / "out")
+        config = BatchPredictConfig(
+            output_dir=out, engine_instance_id=iid, input_path=qfile,
+            chunk_size=8)
+        run_batch_predict(config)
+        reference = _shard_bytes(out)
+        manifest = Manifest.load(os.path.join(out, MANIFEST_NAME))
+        torn = os.path.join(out, manifest.chunks[1]["file"])
+        with open(torn, "r+b") as f:  # truncate mid-record = torn write
+            f.truncate(10)
+        summary = run_batch_predict(config)
+        assert summary["chunksScored"] == 1  # only the torn one
+        assert summary["chunksSkipped"] == summary["chunks"] - 1
+        assert _shard_bytes(out) == reference
+
+    def test_mismatched_job_refused(self, mem_storage, tmp_path):
+        iid, queries = seed_recommendation()
+        qfile = _write_queries(tmp_path, queries)
+        out = str(tmp_path / "out")
+        run_batch_predict(BatchPredictConfig(
+            output_dir=out, engine_instance_id=iid, input_path=qfile,
+            chunk_size=8))
+        other = _write_queries(tmp_path, queries[:-1], name="other.jsonl")
+        with pytest.raises(ValueError, match="different job"):
+            run_batch_predict(BatchPredictConfig(
+                output_dir=out, engine_instance_id=iid, input_path=other,
+                chunk_size=8))
+
+
+# ---------------------------------------------------------------------------
+# Query synthesis + formats + CLI
+# ---------------------------------------------------------------------------
+
+class TestSynthesisAndFormats:
+    def test_synthesize_queries_from_entities(self, mem_storage):
+        iid, _ = seed_recommendation()
+        del iid
+        qs = synthesize_queries("bprec", entity_type="user", field="user",
+                                base={"num": 5})
+        assert qs == [{"num": 5, "user": f"u{u:02d}"} for u in range(20)]
+        with pytest.raises(ValueError, match="entity field"):
+            synthesize_queries("bprec", base={"user": "clash"})
+
+    def test_synthesized_run_and_empty_refused(self, mem_storage,
+                                               tmp_path):
+        iid, _ = seed_recommendation()
+        out = str(tmp_path / "out")
+        summary = run_batch_predict(BatchPredictConfig(
+            output_dir=out, engine_instance_id=iid,
+            synthesize_app="bprec", synthesize_base={"num": 3},
+            chunk_size=8))
+        assert summary["queries"] == 20
+        results = read_results(out)
+        assert all(r["prediction"]["itemScores"] for r in results)
+        # no $set items exist -> synthesizing item queries finds nothing
+        with pytest.raises(ValueError, match="no queries to score"):
+            run_batch_predict(BatchPredictConfig(
+                output_dir=str(tmp_path / "empty"),
+                engine_instance_id=iid, synthesize_app="bprec",
+                synthesize_entity_type="item"))
+
+    def test_npz_format_agrees_with_jsonl(self, mem_storage, tmp_path):
+        iid, queries = seed_recommendation()
+        qfile = _write_queries(tmp_path, queries)
+        out_j = str(tmp_path / "out_jsonl")
+        out_n = str(tmp_path / "out_npz")
+        run_batch_predict(BatchPredictConfig(
+            output_dir=out_j, engine_instance_id=iid, input_path=qfile,
+            chunk_size=8))
+        summary = run_batch_predict(BatchPredictConfig(
+            output_dir=out_n, engine_instance_id=iid, input_path=qfile,
+            chunk_size=8, format="npz"))
+        assert summary["format"] == "npz"
+        assert read_results(out_n) == read_results(out_j)
+        manifest = Manifest.load(os.path.join(out_n, MANIFEST_NAME))
+        assert all(c["file"].endswith(".npz") for c in manifest.chunks)
+        z = np.load(os.path.join(out_n, manifest.chunks[0]["file"]),
+                    allow_pickle=False)
+        assert int(z["count"]) == manifest.chunks[0]["count"]
+
+    def test_query_partitions_spans(self, mem_storage, tmp_path):
+        iid, queries = seed_recommendation()
+        qfile = _write_queries(tmp_path, queries)
+        out = str(tmp_path / "out")
+        summary = run_batch_predict(BatchPredictConfig(
+            output_dir=out, engine_instance_id=iid, input_path=qfile,
+            query_partitions=4))
+        assert summary["chunks"] == 4
+        assert read_results(out)  # all spans land
+
+    def test_batchpredict_metrics_recorded(self, mem_storage, tmp_path):
+        from predictionio_tpu.utils import metrics
+
+        before = metrics.BATCHPREDICT_QUERIES.value(status="scored")
+        iid, queries = seed_recommendation()
+        qfile = _write_queries(tmp_path, queries)
+        run_batch_predict(BatchPredictConfig(
+            output_dir=str(tmp_path / "out"), engine_instance_id=iid,
+            input_path=qfile, chunk_size=8))
+        assert metrics.BATCHPREDICT_QUERIES.value(status="scored") \
+            == before + len(queries)
+        assert metrics.BATCHPREDICT_QPS.value() > 0
+
+
+class TestCli:
+    def test_cli_end_to_end_with_resume(self, mem_storage, tmp_path,
+                                        capsys):
+        from predictionio_tpu.tools.cli import main
+
+        iid, queries = seed_recommendation()
+        qfile = _write_queries(tmp_path, queries)
+        out = str(tmp_path / "out")
+        assert main(["batchpredict", "--engine-instance-id", iid,
+                     "--input", qfile, "--output", out,
+                     "--chunk-size", "8"]) == 0
+        assert "Batch predict completed" in capsys.readouterr().out
+        assert main(["batchpredict", "--engine-instance-id", iid,
+                     "--input", qfile, "--output", out,
+                     "--chunk-size", "8"]) == 0
+        assert "3 resumed" in capsys.readouterr().out
+
+        # error contracts
+        assert main(["batchpredict", "--engine-instance-id", iid,
+                     "--input", qfile]) == 1  # no --output
+        assert main(["batchpredict", "--engine-instance-id", "nope",
+                     "--input", qfile,
+                     "--output", str(tmp_path / "x")]) == 1
+
+    @pytest.mark.slow
+    def test_smoke_entry_point(self, mem_storage, capsys):
+        """The CI smoke: `pio batchpredict --smoke` (train + predict +
+        crash + resume + parity, self-contained)."""
+        from predictionio_tpu.tools.cli import main
+
+        assert main(["batchpredict", "--smoke"]) == 0
+        assert "batchpredict smoke OK" in capsys.readouterr().out
+
+    @pytest.mark.slow
+    def test_larger_e2e_npz(self, mem_storage, tmp_path, capsys):
+        """Slow e2e: synthesized queries for every user at a larger
+        shape, npz shards, killed + resumed via the CLI."""
+        from predictionio_tpu.templates.recommendation import (
+            DataSourceParams,
+        )
+        from predictionio_tpu.tools.cli import main
+
+        app = "bpbig"
+        aid, le = _new_app(app)
+        rng = np.random.default_rng(9)
+        events = [Event(event="$set", entity_type="user",
+                        entity_id=f"u{u:04d}", event_time=T0)
+                  for u in range(600)]
+        for u in range(600):
+            for _ in range(5):
+                events.append(Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"u{u:04d}", target_entity_type="item",
+                    target_entity_id=f"i{rng.integers(0, 50)}",
+                    properties={"rating": float(rng.integers(1, 6))},
+                    event_time=T0))
+        le.insert_batch(events, aid)
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name=app)),
+            algorithm_params_list=[
+                ("als", ALSParams(rank=8, num_iterations=2, seed=0))])
+        iid = _train(
+            "predictionio_tpu.templates.recommendation:engine_factory",
+            params)
+        out = str(tmp_path / "out")
+        config = BatchPredictConfig(
+            output_dir=out, engine_instance_id=iid,
+            synthesize_app=app, synthesize_base={"num": 10},
+            chunk_size=128, format="npz", fail_after_chunks=2)
+        with pytest.raises(RuntimeError, match="fault injection"):
+            run_batch_predict(config)
+        assert main(["batchpredict", "--engine-instance-id", iid,
+                     "--synthesize-app", app,
+                     "--synthesize-base", '{"num": 10}',
+                     "--chunk-size", "128", "--format", "npz",
+                     "--output", out]) == 0
+        assert "2 resumed" in capsys.readouterr().out
+        results = read_results(out)
+        assert len(results) == 600
+        assert all(r["prediction"]["itemScores"] for r in results)

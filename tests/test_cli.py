@@ -215,6 +215,48 @@ class TestExportImport:
         assert got["u2"].properties.fields == {"vip": True}
         assert got["u3"].properties.fields == {}
 
+    def test_columnar_null_sentinel_string_survives(self, mem_storage,
+                                                    tmp_path, capsys):
+        """Regression (advisor finding): the columnar codec used the
+        in-band string ``"\\0N"`` as its null sentinel, so a GENUINE
+        ``"\\0N"`` value (entity id, prId...) decoded back as None. The
+        null mask is now out-of-band; any string value round-trips."""
+        import datetime as dt
+
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.tools import export_import as ei
+
+        # the unit mechanics: sentinel-looking values encode losslessly
+        vals = ["\0N", None, "a", "\0N", "", None]
+        codes, labels = ei._dict_encode(vals)
+        assert ei._dict_decode(codes, labels) == vals
+        codes, labels = ei._dict_encode([None, None])
+        assert ei._dict_decode(codes, labels) == [None, None]
+
+        main(["app", "new", "sentapp"])
+        app = storage.get_metadata_apps().get_by_name("sentapp")
+        le = storage.get_levents()
+        t0 = dt.datetime(2021, 5, 1, tzinfo=dt.timezone.utc)
+        le.insert_batch([
+            Event(event="rate", entity_type="user", entity_id="\0N",
+                  target_entity_type="item", target_entity_id="i1",
+                  pr_id="\0N", event_time=t0),
+            Event(event="view", entity_type="user", entity_id="u2",
+                  target_entity_type="item", target_entity_id="i2",
+                  event_time=t0),
+        ], app.id)
+        out = str(tmp_path / "events.npz")
+        assert main(["export", "--app-name", "sentapp", "--output", out,
+                     "--format", "columnar"]) == 0
+        main(["app", "new", "sentimp"])
+        assert main(["import", "--app-name", "sentimp", "--input",
+                     out]) == 0
+        app2 = storage.get_metadata_apps().get_by_name("sentimp")
+        got = {e.entity_id: e for e in le.find(app2.id)}
+        assert set(got) == {"\0N", "u2"}
+        assert got["\0N"].pr_id == "\0N"
+        assert got["u2"].pr_id is None
+
     def test_columnar_roundtrip_sqlite_raw_lane(self, sqlite_storage,
                                                 tmp_path, capsys):
         import datetime as dt

@@ -112,3 +112,36 @@ class TestWireFormat:
     def test_naive_times_become_utc(self):
         e = ev(event_time=dt.datetime(2020, 1, 1))
         assert e.event_time.tzinfo is not None
+
+    def test_iso8601_variants_accepted(self):
+        """Z suffix, odd fractional-second widths and colon-less offsets
+        must parse on every Python (3.10's fromisoformat rejects them;
+        the shared compat helper normalizes — utils/compat.py)."""
+        utc = dt.timezone.utc
+        cases = {
+            "2021-06-01T12:30:45Z":
+                dt.datetime(2021, 6, 1, 12, 30, 45, tzinfo=utc),
+            "2021-06-01T12:30:45.1Z":
+                dt.datetime(2021, 6, 1, 12, 30, 45, 100000, tzinfo=utc),
+            "2021-06-01T12:30:45.1234567+00:00":
+                dt.datetime(2021, 6, 1, 12, 30, 45, 123456, tzinfo=utc),
+            "2021-06-01T12:30:45+0530":
+                dt.datetime(2021, 6, 1, 12, 30, 45, tzinfo=dt.timezone(
+                    dt.timedelta(hours=5, minutes=30))),
+        }
+        for raw, want in cases.items():
+            e = Event.from_dict({"event": "rate", "entityType": "user",
+                                 "entityId": "u1", "eventTime": raw})
+            assert e.event_time == want, raw
+        with pytest.raises(EventValidationError):
+            Event.from_dict({"event": "rate", "entityType": "user",
+                             "entityId": "u1", "eventTime": "not-a-time"})
+
+    def test_datamap_datetime_accepts_z_suffix(self):
+        from predictionio_tpu.data.datamap import DataMap, DataMapError
+
+        dm = DataMap({"t": "2021-06-01T12:30:45Z", "bad": "nope"})
+        assert dm.get("t", dt.datetime) == dt.datetime(
+            2021, 6, 1, 12, 30, 45, tzinfo=dt.timezone.utc)
+        with pytest.raises(DataMapError):
+            dm.get("bad", dt.datetime)
