@@ -695,6 +695,137 @@ def instrumentation_overhead_bench(n_requests: int = 400,
     }
 
 
+def tracing_overhead_bench(n_queries: int = 150, rounds: int = 3,
+                           n_users: int = 64, n_items: int = 32) -> dict:
+    """Structured tracing must never tax the query hot path: drive the
+    SAME live query server over HTTP in three lanes and report the
+    throughput deltas —
+
+    - ``on``:        tracing enabled, head sampling 1.0 (every query
+      records a full span tree: HTTP root, extract, DASE serve stages,
+      top-k dispatch; retained in the ring)
+    - ``unsampled``: enabled with sample rate 0 — spans still collected
+      for the always-keep (slow/error) lane, retention dropped
+    - ``killed``:    the ``PIO_TRACING=off`` kill switch — every span
+      site returns on a flag check (the seed-equivalent code path)
+
+    The slow/perf-marked test in tests/test_tracing.py gates the killed
+    lane's per-site cost at < 5% of a served query; this bench reports
+    the exact figures for all three lanes."""
+    import http.client
+
+    import datetime as _dt
+
+    from predictionio_tpu.controller import ComputeContext, EngineParams
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import StorageConfig
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.ops.als import ALSParams
+    from predictionio_tpu.templates.recommendation import (
+        DataSourceParams,
+        engine_factory,
+    )
+    from predictionio_tpu.utils import tracing
+    from predictionio_tpu.workflow import (
+        QueryServer,
+        ServerConfig,
+        run_train,
+    )
+    from predictionio_tpu.workflow.create_workflow import (
+        WorkflowConfig,
+        new_engine_instance,
+    )
+
+    factory = "predictionio_tpu.templates.recommendation:engine_factory"
+    storage_mod.reset(StorageConfig(
+        sources={"TRB": {"type": "memory"}},
+        repositories={"METADATA": "TRB", "EVENTDATA": "TRB",
+                      "MODELDATA": "TRB"}))
+    buf = tracing.trace_buffer()
+    prior = (buf.enabled, buf.sample_rate)
+    # production log level: the per-span debug line must not pollute
+    # the measurement with record formatting
+    import logging as _logging
+
+    trace_logger = _logging.getLogger("pio.tracing")
+    prior_level = trace_logger.level
+    trace_logger.setLevel(_logging.INFO)
+    try:
+        aid = storage_mod.get_metadata_apps().insert(App(0, "trbench"))
+        le = storage_mod.get_levents()
+        le.init(aid)
+        rng = np.random.default_rng(7)
+        t0 = _dt.datetime(2021, 1, 1, tzinfo=_dt.timezone.utc)
+        le.insert_batch([
+            Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{rng.integers(0, n_items)}",
+                  properties={"rating": float(rng.integers(1, 6))},
+                  event_time=t0)
+            for u in range(n_users) for _ in range(6)], aid)
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="trbench")),
+            algorithm_params_list=[
+                ("als", ALSParams(rank=8, num_iterations=2, seed=0))])
+        instance = new_engine_instance(
+            WorkflowConfig(engine_factory=factory), params)
+        iid = run_train(engine_factory(), params, instance,
+                        ctx=ComputeContext())
+        assert iid is not None
+        server = QueryServer(ServerConfig(
+            ip="127.0.0.1", port=0, engine_instance_id=iid)).start(
+            undeploy_stale=False)
+        try:
+            host, port = server.address
+            body = json.dumps({"user": "u1", "num": 5}).encode("utf-8")
+
+            def one_round() -> float:
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+                t0 = time.perf_counter()
+                for _ in range(n_queries):
+                    conn.request(
+                        "POST", "/queries.json", body=body,
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    assert resp.status == 200, resp.status
+                took = time.perf_counter() - t0
+                conn.close()
+                return took
+
+            one_round()  # warm every lane's code path
+            results = {}
+            for lane, (enabled, rate) in (
+                    ("on", (True, 1.0)),
+                    ("unsampled", (True, 0.0)),
+                    ("killed", (False, 1.0))):
+                buf.enabled = enabled
+                buf.sample_rate = rate
+                results[lane] = min(one_round() for _ in range(rounds))
+        finally:
+            server.stop()
+    finally:
+        buf.enabled, buf.sample_rate = prior
+        trace_logger.setLevel(prior_level)
+        storage_mod.reset()
+    qps = {lane: round(n_queries / sec, 1)
+           for lane, sec in results.items()}
+    return {
+        "queries": n_queries,
+        "qps_tracing_on": qps["on"],
+        "qps_tracing_unsampled": qps["unsampled"],
+        "qps_tracing_killed": qps["killed"],
+        "overhead_frac_on": round(
+            max(0.0, results["on"] / results["killed"] - 1.0), 4),
+        "overhead_frac_unsampled": round(
+            max(0.0, results["unsampled"] / results["killed"] - 1.0), 4),
+        "note": ("killed = PIO_TRACING=off (flag check per span site, "
+                 "the seed-equivalent path); unsampled keeps collecting "
+                 "for the slow/error always-keep lane"),
+    }
+
+
 def _device_watchdog(timeout_sec: float = 300.0) -> None:
     """Fail LOUDLY if backend init hangs (a dead accelerator tunnel
     blocks inside the PJRT plugin forever): probe ``jax.devices()`` on a
@@ -826,6 +957,9 @@ def main(smoke: bool = False) -> None:
     overhead = instrumentation_overhead_bench(
         n_requests=100 if smoke else 400)
 
+    tracing_overhead = tracing_overhead_bench(
+        **({"n_queries": 50, "n_users": 32} if smoke else {}))
+
     batchpredict = batchpredict_bench(
         **({"n_users": 256, "n_items": 128, "chunk": 64,
             "loop_sample": 64} if smoke else {}))
@@ -859,6 +993,7 @@ def main(smoke: bool = False) -> None:
             "text_classification": text_quality,
             "serving": serving,
             "instrumentation_overhead": overhead,
+            "tracing_overhead": tracing_overhead,
             "batchpredict": batchpredict,
         },
     }))

@@ -55,6 +55,7 @@ from predictionio_tpu.controller.algorithms import ordered_batch_results
 from predictionio_tpu.core.context import ComputeContext, workflow_context
 from predictionio_tpu.parallel.mesh import shard_spans
 from predictionio_tpu.utils import metrics
+from predictionio_tpu.utils.tracing import span, trace_scope
 from predictionio_tpu.workflow.create_server import (
     Deployment,
     build_deployment,
@@ -422,7 +423,16 @@ class BatchPredictor:
 
     def run(self) -> Dict[str, Any]:
         """Score everything, resuming from a prior manifest when the
-        input/instance/format still match. Returns the run summary."""
+        input/instance/format still match. Returns the run summary. The
+        whole run is one trace root with a span per scored chunk, so a
+        stalled bulk job decomposes in Perfetto just like a slow query
+        (``--trace-dir`` / ``$PIO_TRACE_DIR`` exports it)."""
+        with trace_scope("pio.batchpredict",
+                         attributes={"output": self.config.output_dir},
+                         slow_exempt=True):
+            return self._run()
+
+    def _run(self) -> Dict[str, Any]:
         cfg = self.config
         dep = self.load()
         queries = self.read_queries()
@@ -484,10 +494,15 @@ class BatchPredictor:
                 start = chunk["start"]
                 stop = start + chunk["count"]
                 t0 = time.perf_counter()
-                predictions = self.score_chunk(dep, queries[start:stop])
-                records = self._render_records(query_lines[start:stop],
-                                               predictions)
-                chunk["sha256"] = self._write_shard(path, records, start)
+                with span("batchpredict.chunk",
+                          attributes={"chunk": chunk["id"],
+                                      "queries": stop - start}):
+                    predictions = self.score_chunk(dep,
+                                                   queries[start:stop])
+                    records = self._render_records(
+                        query_lines[start:stop], predictions)
+                    chunk["sha256"] = self._write_shard(path, records,
+                                                        start)
                 chunk["status"] = "done"
                 # O(1) completion record; compacted into manifest.json
                 # once at the end (a full rewrite per chunk is O(n^2))

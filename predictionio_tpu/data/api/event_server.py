@@ -613,6 +613,9 @@ class _EventHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         rid = getattr(self, "_request_id", None)
         if rid:
             self.send_header("X-Request-ID", rid)
+        tp = getattr(self, "_traceparent", None)
+        if tp:
+            self.send_header("traceparent", tp)
         self.end_headers()
         self._stream_started = True
         for c in chunks:
@@ -627,11 +630,13 @@ class _EventHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
     # paths (an id or webhook name must not mint a new series)
     def _route_label(self, path: str) -> str:
         if path in ("/", "/metrics", "/stats.json", "/events.json",
-                    "/batch/events.json", "/plugins.json",
+                    "/batch/events.json", "/plugins.json", "/traces.json",
                     "/storage/events.jsonl", "/storage/init.json",
                     "/storage/remove.json", "/storage/delete_until.json",
                     "/storage/aggregate.json"):
             return path
+        if path.startswith("/traces/"):
+            return "/traces/<id>"
         if path.startswith("/storage/events/"):
             return "/storage/events/<id>.json"
         if path.startswith("/events/"):
@@ -671,6 +676,14 @@ class _EventHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
                 # bind it to scrape-network interfaces, not the public
                 # internet (README "Observability")
                 self._respond_prometheus()
+                return
+            if path == "/traces.json" and method == "GET":
+                # trace index/detail are operator surfaces like /metrics
+                # (unauthenticated; bind to scrape-network interfaces)
+                self._respond_traces_index(query)
+                return
+            if path.startswith("/traces/") and method == "GET":
+                self._respond_trace(path[len("/traces/"):], query)
                 return
             if path == "/plugins.json" and method == "GET":
                 self._respond(200, srv.plugin_context.describe())

@@ -24,7 +24,7 @@ import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from predictionio_tpu.data.storage import base
-from predictionio_tpu.utils import metrics
+from predictionio_tpu.utils import metrics, tracing
 from predictionio_tpu.utils.tracing import current_request_id
 
 logger = logging.getLogger("pio.storage.ops")
@@ -89,15 +89,25 @@ class DAOMetricsWrapper(base.LEvents):
                          rid, f" error={error!r}" if error else "")
 
     def _observe(self, op: str, fn: Callable, *args, **kwargs):
-        if not metrics.REGISTRY.enabled:
+        # trace spans are independent of the metrics switch: an active
+        # trace records storage-op spans even with metrics off, and
+        # metrics keep counting when tracing is killed
+        sp, tok = tracing.begin_span(
+            f"storage.{self.metrics_backend}.{op}")
+        record = metrics.REGISTRY.enabled
+        if not record and sp is None:
             return fn(*args, **kwargs)
         t0 = time.perf_counter()
         try:
             result = fn(*args, **kwargs)
         except BaseException as e:
-            self._record(op, t0, error=e)
+            if record:
+                self._record(op, t0, error=e)
+            tracing.finish_span(sp, tok, error=e)
             raise
-        self._record(op, t0)
+        if record:
+            self._record(op, t0)
+        tracing.finish_span(sp, tok)
         return result
 
     # -- LEvents contract -------------------------------------------------
@@ -132,15 +142,28 @@ class DAOMetricsWrapper(base.LEvents):
                              app_id, until_time, channel_id)
 
     def find(self, app_id, channel_id=None, **kwargs):
-        if not metrics.REGISTRY.enabled:
+        # the span is finished by the iterator-exhausted callback (the
+        # scan IS the op), so it must not rebind the context var — the
+        # consuming code in between is not "inside the scan"
+        sp, _ = tracing.begin_span(
+            f"storage.{self.metrics_backend}.find", set_current=False)
+        record = metrics.REGISTRY.enabled
+        if not record and sp is None:
             return self._wrapped.find(app_id, channel_id, **kwargs)
         t0 = time.perf_counter()
         try:
             it = self._wrapped.find(app_id, channel_id, **kwargs)
         except BaseException as e:
-            self._record("find", t0, error=e)
+            if record:
+                self._record("find", t0, error=e)
+            tracing.finish_span(sp, error=e)
             raise
-        return _TimedIterator(it, lambda: self._record("find", t0))
+
+        def done() -> None:
+            if record:
+                self._record("find", t0)
+            tracing.finish_span(sp)
+        return _TimedIterator(it, done)
 
     def materialized_aggregate(self, app_id, entity_type, channel_id=None):
         return self._observe(

@@ -45,6 +45,7 @@ from predictionio_tpu.data.event import (
 )
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import UNSET, StorageError
+from predictionio_tpu.utils.tracing import outbound_context_headers, span
 
 
 class _Wire:
@@ -90,40 +91,59 @@ class _Wire:
                 q[k] = v
         return f"{self.url}{path}?" + urllib.parse.urlencode(q, doseq=True)
 
+    @staticmethod
+    def _inject_context(req) -> None:
+        """Forward the caller's observability context on EVERY wire
+        call: the contextvar request id (so the server's storage-op
+        records join the originating request) and the W3C traceparent
+        (so the server's spans join the originating trace). Must run
+        INSIDE the wire span, which is then the remote spans' parent."""
+        for name, value in outbound_context_headers().items():
+            req.add_header(name, value)
+
     def call(self, method: str, path: str, params: dict,
              body: Optional[bytes] = None, ok=(200,)):
-        req = urllib.request.Request(self._full(path, params), data=body,
-                                     method=method)
-        if body is not None:
-            req.add_header("Content-Type", "application/x-jsonlines")
-        try:
-            with self._open(req) as resp:
-                payload = json.loads(resp.read().decode("utf-8"))
-                status = resp.status
-        except urllib.error.HTTPError as e:
-            status = e.code
+        with span(f"resthttp {method} {path}",
+                  attributes={"url": self.url}):
+            req = urllib.request.Request(self._full(path, params),
+                                         data=body, method=method)
+            if body is not None:
+                req.add_header("Content-Type", "application/x-jsonlines")
+            self._inject_context(req)
             try:
-                payload = json.loads(e.read().decode("utf-8"))
-            except Exception:
-                payload = {"message": str(e)}
-        except OSError as e:  # URLError is an OSError subclass
-            # also covers connection-level failures urlopen does not
-            # wrap (e.g. RemoteDisconnected from plain HTTP hitting a
-            # TLS listener)
-            raise StorageError(
-                f"event server unreachable at {self.url}: {e}") from e
-        if status not in ok:
-            raise StorageError(
-                f"{method} {path} -> {status}: "
-                f"{payload.get('message', payload)}")
-        return status, payload
+                with self._open(req) as resp:
+                    payload = json.loads(resp.read().decode("utf-8"))
+                    status = resp.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+                try:
+                    payload = json.loads(e.read().decode("utf-8"))
+                except Exception:
+                    payload = {"message": str(e)}
+            except OSError as e:  # URLError is an OSError subclass
+                # also covers connection-level failures urlopen does not
+                # wrap (e.g. RemoteDisconnected from plain HTTP hitting a
+                # TLS listener)
+                raise StorageError(
+                    f"event server unreachable at {self.url}: {e}") from e
+            if status not in ok:
+                raise StorageError(
+                    f"{method} {path} -> {status}: "
+                    f"{payload.get('message', payload)}")
+            return status, payload
 
     def stream(self, params: dict):
-        """GET /storage/events.jsonl as a raw byte-chunk iterator."""
-        req = urllib.request.Request(
-            self._full("/storage/events.jsonl", params), method="GET")
+        """GET /storage/events.jsonl as a raw byte-chunk iterator. The
+        wire span covers the connect + response headers (the streamed
+        read itself is accounted by the caller's storage.find span)."""
         try:
-            resp = self._open(req)
+            with span("resthttp GET /storage/events.jsonl",
+                      attributes={"url": self.url, "streaming": True}):
+                req = urllib.request.Request(
+                    self._full("/storage/events.jsonl", params),
+                    method="GET")
+                self._inject_context(req)
+                resp = self._open(req)
         except urllib.error.HTTPError as e:
             try:
                 msg = json.loads(e.read().decode("utf-8")).get("message")

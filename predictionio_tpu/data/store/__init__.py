@@ -193,10 +193,15 @@ def _pool() -> _DaemonReadPool:
 
 def _bounded(fn, timeout: Optional[float]):
     """Run ``fn`` with an optional deadline (seconds). ``None`` = direct
-    call (no extra thread hop on the common local-backend path)."""
+    call (no extra thread hop on the common local-backend path). The
+    deadline path hops to a pool thread, which would otherwise lose the
+    caller's request-id/trace contextvars — exactly where slow-read
+    attribution matters most — so the snapshot rides along."""
     if timeout is None:
         return fn()
-    box, done = _pool().submit(fn)
+    from predictionio_tpu.utils.tracing import carrying_context
+
+    box, done = _pool().submit(carrying_context(fn))
     if not done.wait(timeout):
         raise LEventStoreTimeoutError(
             f"event-store read exceeded {timeout}s")

@@ -41,6 +41,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from predictionio_tpu.utils.tracing import span as _trace_span
+
 
 def seen_tables(seen: Dict[int, np.ndarray], n_rows: int,
                 pad_multiple: int = 8) -> Tuple[np.ndarray, np.ndarray]:
@@ -610,9 +612,12 @@ class DeviceTopK:
         are masked on device. With micro-batching on (the default),
         concurrent callers share ONE device dispatch; a lone caller
         still pays exactly one blocking round trip."""
-        if self._batcher is not None:
-            return self._batcher.submit(int(uid), int(k))
-        return self._user_topk_direct(uid, k)
+        # the trace span covers submit→result, i.e. the full device
+        # round trip the query waits on (micro-batched or direct)
+        with _trace_span("device.user_topk", attributes={"k": int(k)}):
+            if self._batcher is not None:
+                return self._batcher.submit(int(uid), int(k))
+            return self._user_topk_direct(uid, k)
 
     def _user_topk_direct(self, uid: int,
                           k: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -640,23 +645,27 @@ class DeviceTopK:
         candidates (callers filter per row, as `user_topk` does)."""
         uids = np.asarray(uids, dtype=np.int32)
         n = len(uids)
-        bb = _bucket(max(n, 1), lo=8)
-        padded = np.zeros(bb, dtype=np.int32)
-        padded[:n] = uids
-        kb = min(_bucket(k), self.n_items)
-        out = self._batch_program(kb, bb)(
-            self._X, self._Y, self._seen_cols, self._seen_mask, padded)
-        idx, scores = _unpack(np.asarray(out), kb)
-        return idx[:n, :k], scores[:n, :k]
+        with _trace_span("device.users_topk",
+                         attributes={"batch": int(n), "k": int(k)}):
+            bb = _bucket(max(n, 1), lo=8)
+            padded = np.zeros(bb, dtype=np.int32)
+            padded[:n] = uids
+            kb = min(_bucket(k), self.n_items)
+            out = self._batch_program(kb, bb)(
+                self._X, self._Y, self._seen_cols, self._seen_mask, padded)
+            idx, scores = _unpack(np.asarray(out), kb)
+            return idx[:n, :k], scores[:n, :k]
 
     def items_topk(self, idxs, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """Item-similarity top-k for a list of query item indices. With
         micro-batching on, concurrent callers share one vmapped
         dispatch (same discipline as ``user_topk``)."""
-        if self._item_batcher is not None:
-            return self._item_batcher.submit(
-                tuple(int(i) for i in idxs), int(k))
-        return self._items_topk_direct(idxs, k)
+        with _trace_span("device.items_topk",
+                         attributes={"items": len(idxs), "k": int(k)}):
+            if self._item_batcher is not None:
+                return self._item_batcher.submit(
+                    tuple(int(i) for i in idxs), int(k))
+            return self._items_topk_direct(idxs, k)
 
     def _items_topk_direct(self, idxs,
                            k: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -23,6 +23,9 @@ from typing import Any, Dict, Optional, Tuple
 
 from predictionio_tpu.data import storage
 from predictionio_tpu.data.storage.base import AccessKey, App, generate_access_key
+from predictionio_tpu.utils.http_instrumentation import (
+    InstrumentedHandlerMixin,
+)
 
 logger = logging.getLogger("pio.adminserver")
 
@@ -179,31 +182,56 @@ class AdminServer:
         return 404, {"message": f"unknown path {path}"}
 
 
-class _AdminHandler(BaseHTTPRequestHandler):
+class _AdminHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
+    """Mounted on the shared instrumentation mixin (same as the event
+    and query servers): request-id/traceparent accept+echo, per-route
+    counters + latency histograms under ``server="admin"``, and the
+    operator surfaces ``GET /metrics`` / ``GET /traces.json``."""
+
     admin_server: AdminServer
+    metrics_server_label = "admin"
 
     def log_message(self, fmt, *args):  # route through logging, not stderr
         logger.debug(fmt, *args)
 
-    def _respond(self, status: int, payload: Dict[str, Any]) -> None:
-        data = json.dumps(payload).encode("utf-8")
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json; charset=utf-8")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+    def _route_label(self, path: str) -> str:
+        if path in ("/", "/metrics", "/traces.json", "/cmd/app"):
+            return path
+        if path.startswith("/traces/"):
+            return "/traces/<id>"
+        if path.startswith("/cmd/app/"):
+            return ("/cmd/app/<name>/data" if path.endswith("/data")
+                    else "/cmd/app/<name>")
+        return "<other>"
 
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
-        try:
-            status, payload = self.admin_server.handle(
-                method, parsed.path, body)
-        except Exception as e:  # pragma: no cover - defensive
-            logger.exception("admin request failed")
-            status, payload = 500, {"message": str(e)}
-        self._respond(status, payload)
+        # strip BEFORE routing/accounting: "/metrics/" must hit the
+        # same route label (and untraced-route guard) as "/metrics"
+        path = parsed.path.rstrip("/") or "/"
+
+        def handle() -> None:
+            if method == "GET" and path == "/metrics":
+                self._respond_prometheus()
+                return
+            if method == "GET" and path == "/traces.json":
+                self._respond_traces_index(query)
+                return
+            if method == "GET" and path.startswith("/traces/"):
+                self._respond_trace(path[len("/traces/"):], query)
+                return
+            try:
+                status, payload = self.admin_server.handle(
+                    method, path, body)
+            except Exception as e:  # pragma: no cover - defensive
+                logger.exception("admin request failed")
+                status, payload = 500, {"message": str(e)}
+            self._respond(status, payload)
+
+        self._dispatch_instrumented(method, path, handle)
 
     def do_GET(self):
         self._dispatch("GET")

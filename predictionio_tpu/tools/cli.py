@@ -78,6 +78,17 @@ def _add_metrics_arg(p: argparse.ArgumentParser) -> None:
                         "off just freezes the counters")
 
 
+def _add_tracing_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--tracing", choices=("on", "off"), default=None,
+                   help="structured span tracing (default on; env "
+                        "PIO_TRACING=0 also disables). Traces surface at "
+                        "GET /traces.json and via `pio trace`")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="additionally export every retained trace as "
+                        "JSONL (+ slow-queries.log) under DIR; defaults "
+                        "to $PIO_TRACE_DIR when set")
+
+
 def _add_distributed_args(p: argparse.ArgumentParser) -> None:
     """Multi-host topology flags (the spark-submit cluster plane analog,
     Runner.scala:92-210; see parallel/distributed.py for the launch
@@ -176,6 +187,7 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument("--stop-after-read", action="store_true")
     train.add_argument("--stop-after-prepare", action="store_true")
     _add_distributed_args(train)
+    _add_tracing_args(train)
     train.set_defaults(func=run_commands.cmd_train)
 
     ev = sub.add_parser("eval", help="run an evaluation / tuning sweep")
@@ -199,6 +211,7 @@ def build_parser() -> argparse.ArgumentParser:
                           "serving (default: $PIO_SERVER_CONFIG or "
                           "./server.json)")
     _add_metrics_arg(dep)
+    _add_tracing_args(dep)
     dep.set_defaults(func=run_commands.cmd_deploy)
 
     bp = sub.add_parser(
@@ -240,6 +253,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "engine in memory, batch-predict, crash, resume "
                          "and verify — ignores the other flags")
     _add_metrics_arg(bp)
+    _add_tracing_args(bp)
     bp.set_defaults(func=run_commands.cmd_batchpredict)
 
     undep = sub.add_parser("undeploy", help="stop a deployed engine server")
@@ -274,6 +288,40 @@ def build_parser() -> argparse.ArgumentParser:
     dash.add_argument("--server-config", default=None,
                       help="server.json with accessKey/ssl settings")
     dash.set_defaults(func=run_commands.cmd_dashboard)
+
+    from predictionio_tpu.tools import trace_commands
+
+    tr = sub.add_parser(
+        "trace",
+        help="inspect structured traces: list recent, dump one "
+             "(optionally as Perfetto JSON), tail the slow-query log")
+    tr_sub = tr.add_subparsers(dest="trace_command")
+
+    def _add_trace_source(p):
+        p.add_argument("--url", default=None, metavar="URL",
+                       help="a live server's base URL (default "
+                            f"{trace_commands.DEFAULT_URL} unless a "
+                            "--trace-dir/$PIO_TRACE_DIR is available)")
+        p.add_argument("--dir", default=None, metavar="DIR",
+                       help="read from a --trace-dir JSONL export "
+                            "instead of a live server (merges "
+                            "per-process fragments; default "
+                            "$PIO_TRACE_DIR)")
+        p.add_argument("-n", type=int, default=20,
+                       help="max entries to show (default 20)")
+
+    trl = tr_sub.add_parser("list", help="recent retained traces")
+    _add_trace_source(trl)
+    trd = tr_sub.add_parser("dump", help="print one trace's span tree")
+    trd.add_argument("trace_id")
+    trd.add_argument("--perfetto", default=None, metavar="FILE",
+                     help="write Chrome-trace-event JSON to FILE "
+                          "(open at ui.perfetto.dev) instead of "
+                          "printing the tree")
+    _add_trace_source(trd)
+    trt = tr_sub.add_parser("tail", help="the slow-query log")
+    _add_trace_source(trt)
+    tr.set_defaults(func=trace_commands.dispatch)
 
     tpl = sub.add_parser("template", help="engine template scaffolds")
     tpl_sub = tpl.add_subparsers(dest="template_command")

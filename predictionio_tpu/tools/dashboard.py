@@ -26,16 +26,25 @@ from typing import Optional, Tuple
 
 from predictionio_tpu.common import KeyAuthentication, ServerConfig, SSLConfiguration
 from predictionio_tpu.data import storage
+from predictionio_tpu.utils import tracing
+from predictionio_tpu.utils.http_instrumentation import (
+    InstrumentedHandlerMixin,
+)
 
 logger = logging.getLogger("pio.dashboard")
 
 
 @dataclasses.dataclass
 class DashboardConfig:
-    """DashboardConfig (Dashboard.scala:37-40)."""
+    """DashboardConfig (Dashboard.scala:37-40).
+
+    ``trace_dir``: where ``GET /traces/<id>`` looks for stored traces
+    (the ``--trace-dir`` JSONL export of the serving daemons) after the
+    dashboard's own in-process buffer; defaults to ``$PIO_TRACE_DIR``."""
     ip: str = "localhost"
     port: int = 9000
     server_config: Optional[ServerConfig] = None
+    trace_dir: Optional[str] = None
 
 
 class Dashboard:
@@ -102,6 +111,8 @@ class Dashboard:
         parts = [p for p in path.split("/") if p]
         if not parts:
             return 200, "text/html; charset=utf-8", self._index_html(), {}
+        if parts[0] == "traces" and len(parts) == 2:
+            return self._trace_view(parts[1])
         if parts[0] == "engine_instances" and len(parts) == 3:
             instance = self.registry.get_metadata_evaluation_instances() \
                 .get(parts[1])
@@ -122,6 +133,24 @@ class Dashboard:
                     instance.evaluator_results_json, \
                     {"Access-Control-Allow-Origin": "*"}  # CORSSupport
         return 404, "text/plain", "not found", {}
+
+    def _trace_view(self, trace_id: str) -> Tuple[int, str, str, dict]:
+        """HTML timeline of one stored trace: the dashboard's own
+        buffer first (requests it served itself), then the shared
+        ``--trace-dir`` JSONL export — where fragments the query AND
+        event servers wrote merge into one cross-process timeline."""
+        record = tracing.trace_buffer().get(trace_id)
+        if record is None:
+            trace_dir = self.config.trace_dir \
+                or os.environ.get("PIO_TRACE_DIR") or None
+            if trace_dir:
+                found = tracing.load_traces_from_dir(trace_dir,
+                                                     trace_id=trace_id)
+                record = found[0] if found else None
+        if record is None:
+            return 404, "text/plain", f"trace {trace_id} not found", {}
+        return (200, "text/html; charset=utf-8",
+                tracing.render_trace_html(record), {})
 
     def _index_html(self) -> str:
         """The Twirl index template analog (dashboard/index.scala.html)."""
@@ -167,29 +196,50 @@ class Dashboard:
 </body></html>"""
 
 
-class _DashboardHandler(BaseHTTPRequestHandler):
+class _DashboardHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
+    """Mounted on the shared instrumentation mixin (same as the event
+    and query servers): request-id/traceparent accept+echo, per-route
+    counters + latency histograms under ``server="dashboard"``, and the
+    unauthenticated operator scrape surface ``GET /metrics`` (the
+    key-authed routes stay authed)."""
+
     dashboard: Dashboard
+    metrics_server_label = "dashboard"
 
     def log_message(self, fmt, *args):
         logger.debug(fmt, *args)
 
+    def _route_label(self, path: str) -> str:
+        if path in ("/", "/metrics"):
+            return path
+        parts = [p for p in path.split("/") if p]
+        if parts and parts[0] == "engine_instances" and len(parts) == 3:
+            return f"/engine_instances/<id>/{parts[2]}"
+        if parts and parts[0] == "traces" and len(parts) == 2:
+            return "/traces/<id>"
+        return "<other>"
+
     def do_GET(self):
         parsed = urllib.parse.urlparse(self.path)
         params = urllib.parse.parse_qs(parsed.query)
-        try:
-            status, ctype, body, extra = self.dashboard.handle(
-                parsed.path, params)
-        except Exception as e:  # pragma: no cover - defensive
-            logger.exception("dashboard request failed")
-            status, ctype, body, extra = 500, "text/plain", str(e), {}
-        data = body.encode("utf-8")
-        self.send_response(status)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(data)))
-        for k, v in extra.items():
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(data)
+        # strip BEFORE routing/accounting: "/metrics/" must hit the
+        # same route label (and untraced-route guard) as "/metrics"
+        path = parsed.path.rstrip("/") or "/"
+
+        def handle() -> None:
+            if path == "/metrics":
+                self._respond_prometheus()
+                return
+            try:
+                status, ctype, body, extra = self.dashboard.handle(
+                    path, params)
+            except Exception as e:  # pragma: no cover - defensive
+                logger.exception("dashboard request failed")
+                status, ctype, body, extra = 500, "text/plain", str(e), {}
+            self._respond_bytes(status, body.encode("utf-8"), ctype,
+                                extra_headers=extra)
+
+        self._dispatch_instrumented("GET", path, handle)
 
 
 def create_dashboard(config: Optional[DashboardConfig] = None,

@@ -77,6 +77,20 @@ def _apply_metrics_flag(args) -> None:
         metrics.set_enabled(flag == "on")
 
 
+def _apply_tracing_flags(args) -> None:
+    """--tracing on|off + --trace-dir/$PIO_TRACE_DIR -> the tracing
+    switch and the JSONL trace export (None leaves PIO_TRACING alone)."""
+    from predictionio_tpu.utils import tracing
+
+    flag = getattr(args, "tracing", None)
+    if flag is not None:
+        tracing.set_tracing_enabled(flag == "on")
+    trace_dir = getattr(args, "trace_dir", None) \
+        or os.environ.get("PIO_TRACE_DIR") or None
+    if trace_dir:
+        tracing.set_trace_dir(trace_dir)
+
+
 def cmd_train(args) -> int:
     """Console train (Console.scala:834-842) -> create_workflow. A
     profile dir (--profile-dir / $PIO_PROFILE_DIR) captures a
@@ -86,8 +100,9 @@ def cmd_train(args) -> int:
     from predictionio_tpu.utils import metrics
     from predictionio_tpu.workflow.create_workflow import create_workflow
 
-    from predictionio_tpu.utils.tracing import profile_trace
+    from predictionio_tpu.utils.tracing import profile_trace, trace_scope
 
+    _apply_tracing_flags(args)
     try:
         # multi-host runtime (no-op on one host; parallel/distributed.py)
         from predictionio_tpu.parallel import distributed
@@ -101,7 +116,13 @@ def cmd_train(args) -> int:
         profile_dir = getattr(args, "profile_dir", None) \
             or os.environ.get("PIO_PROFILE_DIR") or None
         metrics.install_jit_compile_listener()
-        with profile_trace(profile_dir):
+        # one trace root over the whole train pass: the DASE stage
+        # spans (dase.read/prepare/train/eval) nest under it, and a
+        # --trace-dir exports the tree next to the jax.profiler capture
+        with profile_trace(profile_dir), \
+                trace_scope("pio.train",
+                            attributes={"variant": args.engine_variant},
+                            slow_exempt=True):
             instance_id = create_workflow(config, variant=variant)
     except TrainingInterruption as e:
         print(f"[INFO] Training interrupted: {e}")
@@ -175,6 +196,7 @@ def cmd_deploy(args) -> int:
     from predictionio_tpu.workflow import QueryServer, ServerConfig
 
     _apply_metrics_flag(args)
+    _apply_tracing_flags(args)
     if args.feedback and not args.accesskey:
         # CreateServer.scala:452-455: feedback requires an access key
         print("[ERROR] Feedback loop cannot be enabled because accessKey "
@@ -226,6 +248,7 @@ def cmd_batchpredict(args) -> int:
     )
 
     _apply_metrics_flag(args)
+    _apply_tracing_flags(args)
     if args.smoke:
         return run_smoke()
     if not args.output:
@@ -294,6 +317,7 @@ def cmd_eventserver(args) -> int:
     from predictionio_tpu.data.api import EventServer, EventServerConfig
 
     _apply_metrics_flag(args)
+    _apply_tracing_flags(args)  # $PIO_TRACE_DIR exports this side too
     service_key = getattr(args, "service_key", None) \
         or os.environ.get("PIO_EVENTSERVER_SERVICE_KEY") or None
     server = EventServer(EventServerConfig(

@@ -36,7 +36,10 @@ import re
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from predictionio_tpu.utils.tracing import LatencyHistogram
+from predictionio_tpu.utils.tracing import (
+    LatencyHistogram,
+    current_sampled_trace_id,
+)
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -259,7 +262,11 @@ class Histogram(_Metric):
     def observe(self, value: float, **labels: str) -> None:
         if not self._registry.enabled:
             return
-        self._child(labels).record(value)
+        # an active SAMPLED trace id rides along as the series'
+        # exemplar, so a regressed histogram links straight to an
+        # openable trace (an unsampled id would usually 404)
+        self._child(labels).record(value,
+                                   exemplar=current_sampled_trace_id())
 
     def time(self, **labels: str):
         """Context manager recording the block's wall time."""
@@ -405,7 +412,7 @@ class MetricsRegistry:
                         le = bounds[i] if i < len(bounds) else math.inf
                         buckets.append({"le": _fmt_le(le),
                                         "cumulative": acc})
-                    series.append({
+                    entry = {
                         "labels": labels,
                         "count": total,
                         "sum": sum_,
@@ -413,7 +420,12 @@ class MetricsRegistry:
                         "last": last,
                         "buckets": buckets,
                         "summary": child.summary(),
-                    })
+                    }
+                    ex = child.exemplar
+                    if ex is not None:
+                        entry["exemplar"] = {"traceId": ex[0],
+                                             "value": ex[1]}
+                    series.append(entry)
                 else:
                     series.append({"labels": labels, "value": child.value})
             out[m.name] = {"type": m.kind, "help": m.help, "series": series}

@@ -14,24 +14,61 @@ observability:
   ``X-Request-ID``, carried through a :mod:`contextvars` var so
   :func:`span` log lines and storage-op records can attribute work to
   the request that caused it, across the thread handling it.
+- **structured span trees**: :func:`span` is a real tracing span when a
+  trace is active — trace_id / span_id / parent_id, start/end,
+  attributes, error flag — recorded into a bounded thread-safe
+  in-process :class:`TraceBuffer` with head sampling plus an always-keep
+  lane for slow or errored traces (the slow-query log). A local trace
+  root is opened with :func:`trace_scope` (the HTTP servers open one per
+  request; ``pio train`` / ``pio batchpredict`` open one per run).
+- **cross-process propagation**: W3C ``traceparent``
+  (:func:`parse_traceparent` / :func:`current_traceparent`) carries the
+  context over the resthttp storage wire and the feedback loop, so one
+  trace covers query server → storage wire → event server. Each process
+  retains ITS spans of the trace; ``GET /traces/<id>`` on each server
+  returns the local fragment (same trace_id).
+- **export**: :func:`trace_to_chrome` renders a retained trace as
+  Chrome-trace-event JSON (loadable in Perfetto / ``chrome://tracing``);
+  :func:`set_trace_dir` additionally appends every retained trace as a
+  JSONL line (``traces-<pid>.jsonl``) and slow/errored summaries to
+  ``slow-queries.log`` under the directory (``--trace-dir`` /
+  ``$PIO_TRACE_DIR``). :func:`render_trace_html` is the dashboard's
+  timeline view.
+- kill switch: ``PIO_TRACING=0|off`` (or ``--tracing off``) disables
+  span collection entirely — :func:`span` falls back to the log-line
+  timer, so serving overhead stays negligible (the tracing analog of
+  ``PIO_METRICS``).
 - :func:`profile_trace` — wraps a block in a ``jax.profiler`` trace
   (viewable in TensorBoard/Perfetto) when a directory is given; the
   Spark-UI analog for XLA programs.
-- :func:`span` — debug-log a named wall-clock span (request-id tagged).
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import contextvars
+import json
 import logging
+import os
+import random
 import re
 import secrets
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from bisect import bisect_left
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 logger = logging.getLogger("pio.tracing")
+slow_logger = logging.getLogger("pio.tracing.slow")
 
 # bucket upper bounds in seconds (log-ish scale), last bucket = +inf
 _BOUNDS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5,
@@ -59,15 +96,18 @@ class LatencyHistogram:
         self._sum = 0.0
         self._max = 0.0
         self._last = 0.0
+        self._exemplar: Optional[Tuple[str, float]] = None
 
     @property
     def bounds(self) -> Tuple[float, ...]:
         return self._bounds
 
-    def record(self, seconds: float) -> None:
-        i = 0
-        while i < len(self._bounds) and seconds > self._bounds[i]:
-            i += 1
+    def record(self, seconds: float,
+               exemplar: Optional[str] = None) -> None:
+        # bisect_left over the precomputed bounds: first bound >= value,
+        # i.e. the same ``le`` bucket the old linear scan picked —
+        # O(log n) instead of O(n) per observation on the hot path
+        i = bisect_left(self._bounds, seconds)
         with self._lock:
             self._counts[i] += 1
             self._total += 1
@@ -75,6 +115,16 @@ class LatencyHistogram:
             self._last = seconds
             if seconds > self._max:
                 self._max = seconds
+            if exemplar is not None:
+                # trace-id exemplar: the most recent traced observation,
+                # so a regressed histogram links to an openable trace
+                self._exemplar = (exemplar, seconds)
+
+    @property
+    def exemplar(self) -> Optional[Tuple[str, float]]:
+        """(trace_id, value) of the most recent traced observation."""
+        with self._lock:
+            return self._exemplar
 
     def _percentile_locked(self, q: float) -> float:
         if self._total == 0:
@@ -171,6 +221,7 @@ class LatencyHistogram:
             self._sum = 0.0
             self._max = 0.0
             self._last = 0.0
+            self._exemplar = None
 
 
 # ---------------------------------------------------------------------------
@@ -221,6 +272,783 @@ def request_scope(given: Optional[str] = None):
         reset_request_id(token)
 
 
+# ---------------------------------------------------------------------------
+# Structured spans — trace context + W3C traceparent
+# ---------------------------------------------------------------------------
+
+# monotonic→epoch anchor: every span timestamp is this one wall-clock
+# reading plus a perf_counter delta, so all spans of a process share one
+# clock — a child's start can never precede its parent's and integer-µs
+# Chrome export stays monotonically consistent
+_EPOCH_ANCHOR = time.time() - time.perf_counter()
+
+
+def _now() -> float:
+    return _EPOCH_ANCHOR + time.perf_counter()
+
+
+# ids need uniqueness, not cryptographic strength — token_hex pays an
+# os.urandom syscall per id, which dominated the per-span cost. One
+# secrets-seeded PRNG per thread keeps ids unpredictable-enough and ~4x
+# cheaper on the serving hot path.
+_id_rng = threading.local()
+
+_PID = os.getpid()
+if hasattr(os, "register_at_fork"):  # keep span pids honest across fork
+    os.register_at_fork(
+        after_in_child=lambda: globals().__setitem__("_PID", os.getpid()))
+
+
+def _rng() -> random.Random:
+    rng = getattr(_id_rng, "rng", None)
+    if rng is None:
+        rng = _id_rng.rng = random.Random(secrets.randbits(64))
+    return rng
+
+
+def new_trace_id() -> str:
+    return f"{_rng().getrandbits(128):032x}"
+
+
+def new_span_id() -> str:
+    return f"{_rng().getrandbits(64):016x}"
+
+
+class SpanContext:
+    """(trace_id, active span_id, sampled) — what propagates: into child
+    spans in-process, as ``traceparent`` across processes."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def __repr__(self) -> str:
+        return (f"SpanContext({self.trace_id!r}, {self.span_id!r}, "
+                f"sampled={self.sampled})")
+
+
+_trace_ctx: contextvars.ContextVar[Optional[SpanContext]] = \
+    contextvars.ContextVar("pio_trace_ctx", default=None)
+
+
+def current_trace_context() -> Optional[SpanContext]:
+    return _trace_ctx.get()
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = _trace_ctx.get()
+    return ctx.trace_id if ctx is not None else None
+
+
+# W3C Trace Context, version 00: 2-2-32-16-2 hex fields
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
+    """A remote parent from a ``traceparent`` header, or None for any
+    absent/malformed/all-zero value (a bad header must never break a
+    request — the server just starts a fresh trace)."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return SpanContext(trace_id, span_id,
+                       sampled=bool(int(flags, 16) & 0x01))
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    return (f"00-{ctx.trace_id}-{ctx.span_id}-"
+            f"{'01' if ctx.sampled else '00'}")
+
+
+def current_traceparent() -> Optional[str]:
+    """The header value to inject into an outgoing request (resthttp
+    wire, feedback POST), or None when no trace is active."""
+    ctx = _trace_ctx.get()
+    return format_traceparent(ctx) if ctx is not None else None
+
+
+def current_sampled_trace_id() -> Optional[str]:
+    """The active trace id ONLY when head sampling retained it — what a
+    histogram exemplar may point at (an unsampled trace's id would 404
+    on GET /traces/<id> unless it later turns out slow/errored)."""
+    ctx = _trace_ctx.get()
+    return ctx.trace_id if ctx is not None and ctx.sampled else None
+
+
+def outbound_context_headers() -> Dict[str, str]:
+    """THE outbound propagation rule: the headers every cross-process
+    call (resthttp wire, feedback POST) forwards so the receiving
+    process joins this request's attribution — one definition, used by
+    every client site."""
+    headers: Dict[str, str] = {}
+    rid = _request_id.get()
+    if rid:
+        headers["X-Request-ID"] = rid
+    ctx = _trace_ctx.get()
+    if ctx is not None:
+        headers["traceparent"] = format_traceparent(ctx)
+    return headers
+
+
+def carrying_context(fn: Callable) -> Callable:
+    """Wrap ``fn`` to run under a snapshot of the CURRENT contextvars
+    (request id + trace context): hand the result to a worker thread and
+    the work stays attributed to this request/trace."""
+    snapshot = contextvars.copy_context()
+    return lambda *args, **kwargs: snapshot.run(fn, *args, **kwargs)
+
+
+class Span:
+    """One timed operation in a trace tree."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "start",
+                 "end", "attributes", "error", "thread")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str,
+                 attributes: Optional[Dict[str, Any]] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start = _now()
+        self.end: Optional[float] = None
+        self.attributes: Dict[str, Any] = dict(attributes or {})
+        self.error = False
+        self.thread = threading.get_ident()
+
+    def duration(self) -> float:
+        return (self.end if self.end is not None else _now()) - self.start
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "end": self.end if self.end is not None else self.start,
+            "durationSec": round(self.duration(), 9),
+            "attributes": self.attributes,
+            "error": self.error,
+            "thread": self.thread,
+            "pid": _PID,
+        }
+
+
+def _iso(epoch: float) -> str:
+    import datetime as _dt
+
+    return _dt.datetime.fromtimestamp(
+        epoch, tz=_dt.timezone.utc).isoformat()
+
+
+class TraceBuffer:
+    """Bounded thread-safe store of finished traces.
+
+    - spans of in-flight traces accumulate per trace_id (capped at
+      ``max_spans_per_trace``; overflow is counted, not stored);
+    - when a LOCAL ROOT span ends (:meth:`flush`), the trace is retained
+      iff it was head-sampled OR slow (duration ≥
+      ``slow_threshold_sec``) OR errored — the always-keep lane;
+    - retained traces live in a FIFO ring of ``max_traces`` (oldest
+      evicted first); slow/errored roots additionally append a summary
+      to the slow-query log ring (and the ``pio.tracing.slow`` logger);
+    - the head-sampling decision is a seeded :class:`random.Random`, so
+      a fixed seed reproduces the exact keep/drop sequence.
+    """
+
+    def __init__(self, max_traces: int = 256,
+                 max_spans_per_trace: int = 512,
+                 max_slow: int = 256,
+                 sample_rate: Optional[float] = None,
+                 slow_threshold_sec: Optional[float] = None,
+                 enabled: Optional[bool] = None,
+                 seed: Optional[int] = None):
+        def env_float(name: str, default: float) -> float:
+            # a malformed env knob must not crash every pio command at
+            # import (the module singleton evaluates this) — same
+            # tolerance contract as parse_traceparent
+            raw = os.environ.get(name)
+            if raw is None:
+                return default
+            try:
+                return float(raw)
+            except ValueError:
+                logger.warning("ignoring malformed %s=%r (using %s)",
+                               name, raw, default)
+                return default
+
+        if sample_rate is None:
+            sample_rate = env_float("PIO_TRACE_SAMPLE", 1.0)
+        if slow_threshold_sec is None:
+            slow_threshold_sec = env_float("PIO_TRACE_SLOW_SEC", 0.5)
+        if enabled is None:
+            enabled = os.environ.get("PIO_TRACING", "1").strip().lower() \
+                not in ("0", "off", "false")
+        self.enabled = bool(enabled)
+        self.sample_rate = float(sample_rate)
+        self.slow_threshold_sec = float(slow_threshold_sec)
+        self.max_traces = int(max_traces)
+        self.max_spans_per_trace = int(max_spans_per_trace)
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        # open local roots per trace_id (a trace can have several, e.g.
+        # two resthttp calls of one remote query hitting this server)
+        self._roots: Dict[str, int] = {}
+        self._open: Dict[str, List[Span]] = {}
+        self._dropped: Dict[str, int] = {}
+        self._done: "collections.OrderedDict[str, Dict[str, Any]]" = \
+            collections.OrderedDict()
+        self._slow: "collections.deque" = collections.deque(maxlen=max_slow)
+        self._export_dir: Optional[str] = None
+        self._export_lock = threading.Lock()
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self) -> bool:
+        """One head-sampling decision (deterministic under a seed)."""
+        rate = self.sample_rate
+        if rate >= 1.0:
+            return True
+        if rate <= 0.0:
+            return False
+        with self._lock:
+            return self._rng.random() < rate
+
+    # -- collection --------------------------------------------------------
+    def root_started(self, trace_id: str) -> None:
+        with self._lock:
+            self._roots[trace_id] = self._roots.get(trace_id, 0) + 1
+
+    def add_span(self, span: Span) -> None:
+        """A finished span. Goes to the in-flight set while a local root
+        is open; a late span (e.g. async work outliving its request)
+        lands directly on the retained record, or is dropped when the
+        trace was not retained."""
+        if not self.enabled:
+            return
+        tid = span.trace_id
+        with self._lock:
+            if self._roots.get(tid):
+                spans = self._open.setdefault(tid, [])
+                if len(spans) >= self.max_spans_per_trace:
+                    self._dropped[tid] = self._dropped.get(tid, 0) + 1
+                    return
+                spans.append(span)
+                return
+            rec = self._done.get(tid)
+            if rec is not None \
+                    and len(rec["spans"]) < self.max_spans_per_trace:
+                rec["spans"].append(span)
+
+    def flush(self, root: Span, sampled: bool) -> None:
+        """Retire a local root: decide retention, update the slow-query
+        log, export. Called by :func:`trace_scope` at root exit. Span
+        objects are retained as-is — rendering them to dicts happens at
+        READ time (``get``/``index``/export), off the serving path."""
+        if not self.enabled:
+            return
+        tid = root.trace_id
+        duration = root.duration()
+        # batch jobs (train, batchpredict) exempt themselves: a 40min
+        # train pass is not a slow QUERY and must not drown the log
+        slow = duration >= self.slow_threshold_sec \
+            and not root.attributes.get("slowExempt")
+        err = root.error
+        record: Optional[Dict[str, Any]] = None
+        new_spans: List[Span] = []
+        slow_entry: Optional[Dict[str, Any]] = None
+        with self._lock:
+            open_roots = self._roots.get(tid, 1) - 1
+            if open_roots > 0:
+                self._roots[tid] = open_roots
+            else:
+                self._roots.pop(tid, None)
+            if open_roots > 0 and not (sampled or slow or err):
+                # a sibling root is still collecting; leave the spans
+                self._open.setdefault(tid, []).append(root)
+                return
+            new_spans = self._open.pop(tid, [])
+            new_spans.append(root)
+            dropped = self._dropped.pop(tid, 0)
+            keep = sampled or slow or err
+            existing = self._done.get(tid)
+            if existing is not None:
+                existing["spans"].extend(new_spans)
+                existing["droppedSpans"] += dropped
+                existing["error"] = existing["error"] or err
+                existing["slow"] = existing["slow"] or slow
+                existing["durationSec"] = max(existing["durationSec"],
+                                              round(duration, 9))
+                self._done.move_to_end(tid)
+                record = existing
+            elif keep:
+                record = {
+                    "traceId": tid,
+                    "root": root.name,
+                    "startEpoch": root.start,
+                    "durationSec": round(duration, 9),
+                    "slow": slow,
+                    "error": err,
+                    "sampled": sampled,
+                    "droppedSpans": dropped,
+                    "process": {"pid": _PID},
+                    "spans": list(new_spans),
+                }
+                self._done[tid] = record
+                while len(self._done) > self.max_traces:
+                    self._done.popitem(last=False)
+            if slow or err:
+                slow_entry = {
+                    "time": _iso(root.start),
+                    "traceId": tid,
+                    "name": root.name,
+                    "durationSec": round(duration, 6),
+                    "error": err,
+                    "spans": len(new_spans),
+                }
+                self._slow.append(slow_entry)
+        if slow_entry is not None:
+            slow_logger.warning(
+                "%s trace %s: %s took %.3fs (%d spans)",
+                "errored" if err else "slow", tid, root.name, duration,
+                slow_entry["spans"])
+        if record is not None and self._export_dir:
+            self._export(self._render(record, spans=new_spans),
+                         slow_entry)
+
+    @staticmethod
+    def _render(record: Dict[str, Any],
+                spans: Optional[List[Any]] = None) -> Dict[str, Any]:
+        """A retained record as pure JSON-shaped data (spans may still
+        be live Span objects internally)."""
+        use = record["spans"] if spans is None else spans
+        out = {k: v for k, v in record.items()
+               if k not in ("spans", "startEpoch")}
+        out["startTime"] = _iso(record["startEpoch"])
+        out["spans"] = [s.to_dict() if isinstance(s, Span) else s
+                        for s in use]
+        return out
+
+    # -- reads -------------------------------------------------------------
+    def index(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Summaries of retained traces, newest first."""
+        with self._lock:
+            recent = [(rec, len(rec["spans"]))
+                      for rec in list(self._done.values())[-limit:]]
+        out = []
+        for rec, n_spans in reversed(recent):
+            summary = {k: rec[k] for k in
+                       ("traceId", "root", "durationSec", "slow",
+                        "error", "droppedSpans")}
+            summary["startTime"] = _iso(rec["startEpoch"])
+            summary["spans"] = n_spans
+            out.append(summary)
+        return out
+
+    def get(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._done.get(trace_id)
+            if rec is None:
+                return None
+            spans = list(rec["spans"])
+        return self._render({**rec, "spans": spans})
+
+    def slow_log(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Recent slow/errored trace summaries, newest first."""
+        with self._lock:
+            return list(self._slow)[-limit:][::-1]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._roots.clear()
+            self._open.clear()
+            self._dropped.clear()
+            self._done.clear()
+            self._slow.clear()
+
+    # -- file export -------------------------------------------------------
+    def set_export_dir(self, path: Optional[str]) -> None:
+        if path:
+            os.makedirs(path, exist_ok=True)
+        self._export_dir = path
+
+    def _export(self, record: Dict[str, Any],
+                slow_entry: Optional[Dict[str, Any]]) -> None:
+        d = self._export_dir
+        if not d:
+            return
+        try:
+            with self._export_lock:
+                path = os.path.join(d, f"traces-{os.getpid()}.jsonl")
+                with open(path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(record, separators=(",", ":"))
+                            + "\n")
+                if slow_entry is not None:
+                    with open(os.path.join(d, "slow-queries.log"), "a",
+                              encoding="utf-8") as f:
+                        f.write(json.dumps(slow_entry,
+                                           separators=(",", ":")) + "\n")
+        except OSError:
+            logger.exception("trace export to %s failed", d)
+
+
+# the process-wide buffer (the analog of metrics.REGISTRY)
+TRACES = TraceBuffer()
+
+
+def trace_buffer() -> TraceBuffer:
+    return TRACES
+
+
+def set_tracing_enabled(enabled: bool) -> None:
+    """Process-wide tracing switch (``--tracing on|off`` /
+    ``PIO_TRACING``). Disabled, :func:`span` is the plain log-line timer
+    and :func:`trace_scope` yields None."""
+    TRACES.enabled = bool(enabled)
+
+
+def set_trace_dir(path: Optional[str]) -> None:
+    """JSONL-export every retained trace (and slow-query summaries) to
+    files under ``path`` (``--trace-dir`` / ``$PIO_TRACE_DIR``)."""
+    TRACES.set_export_dir(path)
+
+
+def load_traces_from_dir(path: str, trace_id: Optional[str] = None,
+                         limit: Optional[int] = None
+                         ) -> List[Dict[str, Any]]:
+    """Read trace records back from a ``--trace-dir``, merging fragments
+    of the same trace_id across files (i.e. across processes)."""
+    merged: "collections.OrderedDict[str, Dict[str, Any]]" = \
+        collections.OrderedDict()
+    try:
+        names = sorted(n for n in os.listdir(path)
+                       if n.startswith("traces-") and n.endswith(".jsonl"))
+    except OSError:
+        return []
+    for name in names:
+        try:
+            with open(os.path.join(path, name), "r",
+                      encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if trace_id is not None and trace_id not in line:
+                        # substring pre-filter: a single-trace lookup
+                        # over a months-old export must skip ~every
+                        # line at I/O speed, not json-parse it
+                        continue
+                    try:
+                        rec = json.loads(line)
+                        tid = rec["traceId"]
+                    except (json.JSONDecodeError, TypeError, KeyError):
+                        continue
+                    if trace_id is not None and tid != trace_id:
+                        continue  # exact check behind the substring gate
+                    prior = merged.get(tid)
+                    if prior is None:
+                        merged[tid] = rec
+                    else:
+                        # the fragment holding the TOPMOST span (no
+                        # parent) names the merged trace: "pio.train",
+                        # not the event server's wire-request root
+                        def topmost(r):
+                            return any(s.get("parentId") is None
+                                       for s in r.get("spans", ()))
+                        if topmost(rec) and not topmost(prior):
+                            rec["spans"] = list(rec.get("spans", ())) \
+                                + list(prior.get("spans", ()))
+                            rec["durationSec"] = max(
+                                prior.get("durationSec", 0.0),
+                                rec.get("durationSec", 0.0))
+                            rec["error"] = prior.get("error", False) \
+                                or rec.get("error", False)
+                            rec["slow"] = prior.get("slow", False) \
+                                or rec.get("slow", False)
+                            merged[tid] = rec
+                            continue
+                        prior["spans"].extend(rec.get("spans", ()))
+                        prior["durationSec"] = max(
+                            prior.get("durationSec", 0.0),
+                            rec.get("durationSec", 0.0))
+                        prior["error"] = prior.get("error") \
+                            or rec.get("error", False)
+                        prior["slow"] = prior.get("slow") \
+                            or rec.get("slow", False)
+        except OSError:
+            continue
+    out = list(merged.values())
+    if limit is not None:
+        out = out[-limit:]
+    return out
+
+
+def load_slow_log_from_dir(path: str, limit: int = 50
+                           ) -> List[Dict[str, Any]]:
+    """The last ``limit`` slow-query-log entries under a trace dir."""
+    entries: List[Dict[str, Any]] = []
+    try:
+        with open(os.path.join(path, "slow-queries.log"), "r",
+                  encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return []
+    for line in lines[-limit:]:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entries.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return entries[::-1]
+
+
+# -- span machinery ---------------------------------------------------------
+
+def begin_span(name: str, attributes: Optional[Dict[str, Any]] = None,
+               set_current: bool = True
+               ) -> Tuple[Optional[Span], Optional[contextvars.Token]]:
+    """Manual span start: a child of the current context, or (None,
+    None) when no trace is active / tracing is off. ``set_current=False``
+    skips rebinding the contextvar (for spans finished by callbacks that
+    may not nest, e.g. a lazy storage scan)."""
+    if not TRACES.enabled:
+        return None, None
+    ctx = _trace_ctx.get()
+    if ctx is None:
+        return None, None
+    sp = Span(ctx.trace_id, new_span_id(), ctx.span_id, name, attributes)
+    token = None
+    if set_current:
+        token = _trace_ctx.set(
+            SpanContext(ctx.trace_id, sp.span_id, ctx.sampled))
+    return sp, token
+
+
+def finish_span(sp: Optional[Span],
+                token: Optional[contextvars.Token] = None,
+                error: Optional[BaseException] = None) -> None:
+    """Manual span end: stamps the end time, flags the error, restores
+    the context and records the span into the buffer."""
+    if token is not None:
+        _trace_ctx.reset(token)
+    if sp is None:
+        return
+    sp.end = _now()
+    if error is not None:
+        sp.error = True
+        sp.attributes.setdefault("exception", type(error).__name__)
+    TRACES.add_span(sp)
+
+
+@contextlib.contextmanager
+def trace_scope(name: str, parent: Optional[SpanContext] = None,
+                attributes: Optional[Dict[str, Any]] = None,
+                slow_exempt: bool = False):
+    """Open a LOCAL TRACE ROOT for the block and flush it at exit.
+
+    - no active context, no ``parent``: a fresh trace (head-sampled);
+    - ``parent`` given (a remote W3C traceparent): this process's root
+      joins that trace and inherits its sampling decision;
+    - a local context already active: degrades to a plain child
+      :func:`span` (nested scopes don't start new traces).
+
+    ``slow_exempt`` keeps a long-by-design job (train, batchpredict)
+    out of the slow-QUERY log. Yields the root :class:`Span` (mutable:
+    handlers set status attributes / the error flag before exit), or
+    None when tracing is disabled."""
+    buf = TRACES
+    if not buf.enabled:
+        yield None
+        return
+    if _trace_ctx.get() is not None:
+        with span(name, attributes=attributes) as sp:
+            yield sp
+        return
+    if parent is not None:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+        sampled = parent.sampled
+    else:
+        trace_id, parent_id = new_trace_id(), None
+        sampled = buf.sample()
+    attributes = dict(attributes or {})
+    if slow_exempt:
+        attributes["slowExempt"] = True
+    root = Span(trace_id, new_span_id(), parent_id, name, attributes)
+    buf.root_started(trace_id)
+    token = _trace_ctx.set(SpanContext(trace_id, root.span_id, sampled))
+    error: Optional[BaseException] = None
+    try:
+        yield root
+    except BaseException as e:
+        error = e
+        raise
+    finally:
+        _trace_ctx.reset(token)
+        root.end = _now()
+        if error is not None:
+            root.error = True
+            root.attributes.setdefault("exception", type(error).__name__)
+        buf.flush(root, sampled)  # flush records the root itself
+
+
+@contextlib.contextmanager
+def span(name: str, level: int = logging.DEBUG,
+         histogram: Optional[LatencyHistogram] = None,
+         attributes: Optional[Dict[str, Any]] = None):
+    """Time a block. Inside an active trace this records a real child
+    span (trace/span/parent ids, attributes, error flag) into the trace
+    buffer; otherwise — or with tracing killed — it is exactly the old
+    request-id-tagged log line. ``histogram`` additionally records the
+    duration (how the DASE-stage spans feed ``pio_train_stage_seconds``).
+    Yields the :class:`Span` (or None)."""
+    t0 = time.perf_counter()
+    sp, token = begin_span(name, attributes)
+    error: Optional[BaseException] = None
+    try:
+        yield sp
+    except BaseException as e:
+        error = e
+        raise
+    finally:
+        took = time.perf_counter() - t0
+        finish_span(sp, token, error=error)
+        if histogram is not None:
+            histogram.record(took)
+        rid = current_request_id()
+        if rid:
+            logger.log(level, "%s took %.3fs [rid=%s]", name, took, rid)
+        else:
+            logger.log(level, "%s took %.3fs", name, took)
+
+
+# ---------------------------------------------------------------------------
+# Exporters
+# ---------------------------------------------------------------------------
+
+def trace_to_chrome(record: Dict[str, Any]) -> Dict[str, Any]:
+    """A retained trace record as Chrome-trace-event JSON: one complete
+    (``ph: "X"``) event per span, µs timestamps/durations. Loadable in
+    Perfetto (ui.perfetto.dev) and ``chrome://tracing``. Integer-µs
+    endpoints are truncated from the same monotonic clock, so a child
+    event always sits inside its parent's [ts, ts+dur] window."""
+    default_pid = (record.get("process") or {}).get("pid", 0)
+    events = []
+    for s in record.get("spans", ()):
+        ts = int(float(s["start"]) * 1e6)
+        end = int(float(s["end"]) * 1e6)
+        args = {k: v for k, v in (s.get("attributes") or {}).items()}
+        args["spanId"] = s.get("spanId")
+        if s.get("parentId"):
+            args["parentId"] = s["parentId"]
+        if s.get("error"):
+            args["error"] = True
+        events.append({
+            "name": s["name"],
+            "cat": "pio",
+            "ph": "X",
+            "ts": ts,
+            "dur": max(0, end - ts),
+            "pid": s.get("pid", default_pid),
+            "tid": s.get("thread", 0),
+            "args": args,
+        })
+    return {
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "traceId": record.get("traceId"),
+            "root": record.get("root"),
+            "source": "predictionio-tpu",
+        },
+        "traceEvents": events,
+    }
+
+
+def render_trace_html(record: Dict[str, Any]) -> str:
+    """A minimal self-contained HTML timeline of one trace (the
+    dashboard's trace view): one bar per span, offset/width proportional
+    to start/duration, indented by tree depth."""
+    import html as _html
+
+    spans = sorted(record.get("spans", ()),
+                   key=lambda s: float(s["start"]))
+    if spans:
+        t0 = min(float(s["start"]) for s in spans)
+        t1 = max(float(s["end"]) for s in spans)
+    else:
+        t0, t1 = 0.0, 1.0
+    total = max(t1 - t0, 1e-9)
+    by_id = {s.get("spanId"): s for s in spans}
+
+    def depth(s, _seen=None) -> int:
+        d = 0
+        seen = set()
+        cur = s
+        while cur is not None and cur.get("parentId") in by_id:
+            if cur.get("spanId") in seen:
+                break
+            seen.add(cur.get("spanId"))
+            cur = by_id[cur["parentId"]]
+            d += 1
+        return d
+
+    rows = []
+    for s in spans:
+        left = (float(s["start"]) - t0) / total * 100.0
+        width = max((float(s["end"]) - float(s["start"])) / total * 100.0,
+                    0.15)
+        ms = (float(s["end"]) - float(s["start"])) * 1000.0
+        pad = depth(s) * 14
+        color = "#c0392b" if s.get("error") else "#2e86c1"
+        name = _html.escape(str(s["name"]))
+        pid = s.get("pid", "")
+        rows.append(
+            f"<div class='row'><div class='label' "
+            f"style='padding-left:{pad}px'>{name} "
+            f"<span class='ms'>{ms:.2f}ms · pid {pid}</span></div>"
+            f"<div class='track'><div class='bar' style='left:{left:.3f}%;"
+            f"width:{width:.3f}%;background:{color}'></div></div></div>")
+    tid = _html.escape(str(record.get("traceId", "")))
+    head = _html.escape(str(record.get("root", "")))
+    dur = float(record.get("durationSec", 0.0)) * 1000.0
+    flags = []
+    if record.get("slow"):
+        flags.append("SLOW")
+    if record.get("error"):
+        flags.append("ERROR")
+    flag_s = (" [" + ", ".join(flags) + "]") if flags else ""
+    return f"""<!DOCTYPE html>
+<html><head><title>Trace {tid}</title><style>
+body {{ font-family: monospace; margin: 16px; }}
+.row {{ display: flex; align-items: center; margin: 1px 0; }}
+.label {{ width: 42%; white-space: nowrap; overflow: hidden;
+          text-overflow: ellipsis; font-size: 12px; }}
+.ms {{ color: #888; }}
+.track {{ position: relative; flex: 1; height: 14px;
+          background: #f2f3f4; }}
+.bar {{ position: absolute; top: 2px; height: 10px; min-width: 1px; }}
+</style></head><body>
+<h2>Trace {tid}{flag_s}</h2>
+<p>root: {head} · {dur:.2f}ms · {len(rows)} spans ·
+started {_html.escape(str(record.get('startTime', '')))}</p>
+{''.join(rows)}
+</body></html>"""
+
+
+# ---------------------------------------------------------------------------
+# jax.profiler wrapper
+# ---------------------------------------------------------------------------
+
 @contextlib.contextmanager
 def profile_trace(trace_dir: Optional[str] = None):
     """Capture a jax.profiler trace of the block into ``trace_dir``
@@ -243,24 +1071,3 @@ def profile_trace(trace_dir: Optional[str] = None):
     metrics.PROFILE_TRACES.inc()
     logger.info("profiler trace written to %s (%.3fs)", trace_dir,
                 time.perf_counter() - t0)
-
-
-@contextlib.contextmanager
-def span(name: str, level: int = logging.DEBUG,
-         histogram: Optional[LatencyHistogram] = None):
-    """Log the wall-clock duration of a block, tagged with the current
-    request id (when one is bound) so concurrent servers produce
-    attributable logs. ``histogram`` additionally records the duration
-    (how the DASE-stage spans feed ``pio_train_stage_seconds``)."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        took = time.perf_counter() - t0
-        if histogram is not None:
-            histogram.record(took)
-        rid = current_request_id()
-        if rid:
-            logger.log(level, "%s took %.3fs [rid=%s]", name, took, rid)
-        else:
-            logger.log(level, "%s took %.3fs", name, took)

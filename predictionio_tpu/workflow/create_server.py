@@ -51,7 +51,11 @@ from predictionio_tpu.utils import metrics
 from predictionio_tpu.utils.http_instrumentation import (
     InstrumentedHandlerMixin,
 )
-from predictionio_tpu.utils.tracing import LatencyHistogram
+from predictionio_tpu.utils.tracing import (
+    LatencyHistogram,
+    outbound_context_headers,
+    span,
+)
 from predictionio_tpu.workflow import core_workflow
 from predictionio_tpu.workflow.server_plugins import EngineServerPluginContext
 
@@ -333,13 +337,18 @@ def warm_up(dep: Deployment,
 
 def serve_query(dep: Deployment, query: Any) -> Any:
     """The single-query DASE serve path: supplement → predict per
-    algorithm → serve with the ORIGINAL query (scala :538-540)."""
-    supplemented = dep.serving.supplement_base(query)
-    predictions = [
-        algo.predict_base(model, supplemented)
-        for algo, model in zip(dep.algorithms, dep.models)
-    ]
-    return dep.serving.serve_base(query, predictions)
+    algorithm → serve with the ORIGINAL query (scala :538-540). Each
+    stage is a trace span, so a slow query decomposes into the stage
+    that cost it (the reference could only say "the query was slow")."""
+    with span("serve.supplement"):
+        supplemented = dep.serving.supplement_base(query)
+    predictions = []
+    for algo, model in zip(dep.algorithms, dep.models):
+        with span("serve.predict",
+                  attributes={"algorithm": type(algo).__name__}):
+            predictions.append(algo.predict_base(model, supplemented))
+    with span("serve.serve"):
+        return dep.serving.serve_base(query, predictions)
 
 
 class QueryServer:
@@ -419,7 +428,8 @@ class QueryServer:
         # extraction errors are the client's fault (400, scala :644-651);
         # anything thrown past extraction is an engine failure (500)
         try:
-            query = self._extract_query(dep, query_dict)
+            with span("query.extract"):
+                query = self._extract_query(dep, query_dict)
         except (ValueError, TypeError) as e:
             logger.error("Query %r is invalid. Reason: %s", query_dict, e)
             return 400, {"message": str(e)}
@@ -472,13 +482,17 @@ class QueryServer:
         url = (f"http://{self.config.event_server_ip}:"
                f"{self.config.event_server_port}/events.json"
                f"?accessKey={self.config.access_key or ''}")
+        # capture the request's observability context NOW (the POST runs
+        # on a detached thread after the response is gone): the event
+        # server's spans for the feedback insert join the query's trace
+        headers = {"Content-Type": "application/json",
+                   **outbound_context_headers()}
 
         def post():
             try:
                 req = urllib.request.Request(
                     url, data=json.dumps(data).encode("utf-8"),
-                    headers={"Content-Type": "application/json"},
-                    method="POST")
+                    headers=headers, method="POST")
                 with urllib.request.urlopen(req, timeout=10) as resp:
                     if resp.status != 201:
                         logger.error(
@@ -650,18 +664,22 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         return self.rfile.read(length) if length else b""
 
     _ROUTES = ("/", "/metrics", "/stats.json", "/plugins.json",
-               "/queries.json", "/reload", "/stop")
+               "/queries.json", "/reload", "/stop", "/traces.json")
 
     def _route_label(self, path: str) -> str:
+        if path.startswith("/traces/"):
+            return "/traces/<id>"
         return path if path in self._ROUTES else "<other>"
 
     def _dispatch(self, method: str) -> None:
-        path = urllib.parse.urlsplit(self.path).path.rstrip("/") or "/"
-        handle = (lambda: self._do_get(path)) if method == "GET" \
+        parsed = urllib.parse.urlsplit(self.path)
+        path = parsed.path.rstrip("/") or "/"
+        query = urllib.parse.parse_qs(parsed.query)
+        handle = (lambda: self._do_get(path, query)) if method == "GET" \
             else (lambda: self._do_post(path))
         self._dispatch_instrumented(method, path, handle)
 
-    def _do_get(self, path: str) -> None:
+    def _do_get(self, path: str, query) -> None:
         srv = self.query_server
         self._drain()
         if path == "/":
@@ -670,6 +688,10 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
             self._respond_prometheus()
         elif path == "/stats.json":
             self._respond(200, srv.stats_json())
+        elif path == "/traces.json":
+            self._respond_traces_index(query)
+        elif path.startswith("/traces/"):
+            self._respond_trace(path[len("/traces/"):], query)
         elif path == "/plugins.json":
             self._respond(200, srv.plugin_context.describe())
         else:

@@ -206,3 +206,119 @@ class TestSSLConfiguration:
     def test_disabled_raises(self):
         with pytest.raises(ValueError):
             SSLConfiguration(ServerConfig()).ssl_context()
+
+
+class TestAdminDashboardObservability:
+    """PR-4 satellite: the admin server and dashboard get the same
+    InstrumentedHandlerMixin treatment as the event/query servers —
+    GET /metrics + per-route counters/latency histograms + request-id
+    and traceparent handling."""
+
+    @pytest.fixture
+    def admin(self, mem_storage):
+        from predictionio_tpu.tools.admin_server import (
+            AdminServer, AdminServerConfig,
+        )
+
+        server = AdminServer(
+            AdminServerConfig(ip="127.0.0.1", port=0)).start()
+        yield f"http://127.0.0.1:{server.port}", server
+        server.stop()
+
+    @pytest.fixture
+    def dash(self, mem_storage):
+        from predictionio_tpu.tools.dashboard import (
+            Dashboard, DashboardConfig,
+        )
+
+        server = Dashboard(DashboardConfig(ip="127.0.0.1", port=0)).start()
+        yield f"http://127.0.0.1:{server.port}", server
+        server.stop()
+
+    @staticmethod
+    def _scrape(url):
+        import sys
+
+        sys.path.insert(0, str(__import__("pathlib").Path(
+            __file__).parent))
+        from test_metrics import parse_prometheus
+
+        with urllib.request.urlopen(url + "/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            return parse_prometheus(r.read().decode("utf-8"))
+
+    def test_admin_metrics_endpoint_and_route_counters(self, admin):
+        url, _ = admin
+        _req(url + "/")
+        _req(url + "/cmd/app")
+        _req(url + "/cmd/app/nosuchapp", "DELETE")
+        samples, types = self._scrape(url)
+        assert types["pio_http_requests_total"] == "counter"
+        assert samples[("pio_http_requests_total",
+                        (("method", "GET"), ("route", "/cmd/app"),
+                         ("server", "admin"), ("status", "200")))] >= 1
+        # app names are route-patterned, never raw label values
+        routes = {dict(k[1]).get("route") for k in samples
+                  if k[0] == "pio_http_requests_total"
+                  and dict(k[1]).get("server") == "admin"}
+        assert "/cmd/app/<name>" in routes
+        assert not any(r and "nosuchapp" in r for r in routes)
+        # latency histogram rode along
+        assert samples[("pio_http_request_seconds_count",
+                        (("route", "/cmd/app"),
+                         ("server", "admin")))] >= 1
+
+    def test_admin_request_id_and_traceparent_echo(self, admin):
+        url, _ = admin
+        req = urllib.request.Request(
+            url + "/", headers={
+                "X-Request-ID": "admin-rid-7",
+                "traceparent": "00-" + "fe" * 16 + "-" + "dc" * 8 + "-01",
+            })
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.headers["X-Request-ID"] == "admin-rid-7"
+            tp = r.headers["traceparent"]
+        assert tp is not None and tp.split("-")[1] == "fe" * 16
+
+    def test_dashboard_metrics_endpoint_unauthenticated(self, dash):
+        """GET /metrics is the operator scrape surface — reachable
+        without the dashboard access key, like the event server's."""
+        url, _ = dash
+        _req(url + "/")
+        samples, _ = self._scrape(url)
+        assert samples[("pio_http_requests_total",
+                        (("method", "GET"), ("route", "/"),
+                         ("server", "dashboard"), ("status", "200")))] >= 1
+        assert samples[("pio_http_request_seconds_count",
+                        (("route", "/"), ("server", "dashboard")))] >= 1
+
+    def test_dashboard_trace_timeline_view(self, dash, tmp_path):
+        """GET /traces/<id> renders a stored trace as an HTML timeline —
+        from the shared --trace-dir export, where query- and event-server
+        fragments of one trace merge into a cross-process view."""
+        from predictionio_tpu.utils import tracing
+
+        buf = tracing.trace_buffer()
+        prior = (buf.enabled, buf.sample_rate, buf.slow_threshold_sec)
+        buf.reset()
+        buf.enabled, buf.sample_rate = True, 1.0
+        buf.slow_threshold_sec = 3600.0
+        buf.set_export_dir(str(tmp_path))
+        try:
+            with tracing.trace_scope("deep.query") as root:
+                with tracing.span("serve.predict"):
+                    pass
+            tid = root.trace_id
+            buf.reset()  # NOT in the buffer: must load from the dir
+            url, server = dash
+            server.config.trace_dir = str(tmp_path)
+            status, body = _req(url + f"/traces/{tid}")
+            assert status == 200
+            assert tid in body and "serve.predict" in body
+            status, _ = _req(url + "/traces/deadbeef")
+            assert status == 404
+        finally:
+            buf.set_export_dir(None)
+            buf.reset()
+            buf.enabled, buf.sample_rate, buf.slow_threshold_sec = prior

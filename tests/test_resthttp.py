@@ -128,6 +128,53 @@ class TestWireBasics:
         conn.close()
         le.remove(70)
 
+    def test_request_id_forwarded_on_every_wire_call(self, mem_storage,
+                                                     caplog):
+        """Regression: the resthttp client must forward the contextvar
+        request id on EVERY storage call, so the server-side storage-op
+        records join the originating request. (Before this fix the wire
+        sent no X-Request-ID at all and server-side attribution died at
+        the process boundary.) An in-process event server lets caplog
+        see the server-side records directly."""
+        import logging
+
+        from predictionio_tpu.data.api.event_server import (
+            EventServer, EventServerConfig,
+        )
+        from predictionio_tpu.utils.tracing import request_scope
+
+        server = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0,
+                              service_key="rid-secret"),
+            reg=mem_storage).start()
+        try:
+            host, port = server.address
+            le = RestLEvents({"url": f"http://{host}:{port}",
+                              "service_key": "rid-secret"})
+            with caplog.at_level(logging.DEBUG, logger="pio.storage.ops"):
+                with request_scope("rid-wire-55"):
+                    le.init(80)
+                    eid = le.insert(
+                        Event(event="rate", entity_type="user",
+                              entity_id="u1", event_time=t(0)), 80)
+                    le.get(eid, 80)
+                    list(le.find(app_id=80, limit=-1))
+                    le.aggregate_properties(80, "user")
+            # server-side records (the wrapped memory DAO behind the
+            # event server) carry the CLIENT's request id
+            server_side = [r.message for r in caplog.records
+                           if "memory." in r.message]
+            assert server_side, "no server-side storage-op records"
+            tagged = [m for m in server_side if "rid=rid-wire-55" in m]
+            assert tagged, server_side
+            # every wire-crossing op family is attributed (insert rides
+            # the batch append lane server-side)
+            for op in ("memory.init", "insert", "memory.get",
+                       "memory.find"):
+                assert any(op in m for m in tagged), (op, tagged)
+        finally:
+            server.stop()
+
     def test_reserved_character_event_id_roundtrip(self, wire):
         le = RestLEvents(wire)
         le.init(71)
