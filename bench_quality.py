@@ -245,6 +245,47 @@ def run(n_users: int = None, n_items: int = None, nnz: int = None,
     }
 
 
+def run_precision_check(n_users: int = None, n_items: int = None,
+                        nnz: int = None, seed: int = 7,
+                        iterations: int = ITERATIONS) -> dict:
+    """Quality gate for the bf16 training policy (ops/als.py
+    ``ALSParams.precision``): train the SAME ml100k-shaped leave-last-out
+    split under fp32 and bf16 from the same seed and report both
+    Precision@10. The slow-marked test in tests/test_als_precision.py
+    asserts the bf16 drop stays within 0.02 absolute — the hard gate the
+    policy ships behind."""
+    import dataclasses as _dc
+
+    import bench
+    from predictionio_tpu.ops.als import ALSParams, pad_ratings, train_als
+
+    n_users = n_users if n_users is not None else bench.N_USERS
+    n_items = n_items if n_items is not None else bench.N_ITEMS
+    nnz = nnz if nnz is not None else bench.NNZ
+    rows, cols, vals, held = build_split(n_users, n_items, nnz, seed)
+    user_side = pad_ratings(rows, cols, vals, n_users, n_items)
+    item_side = pad_ratings(cols, rows, vals, n_items, n_users)
+    params = ALSParams(rank=RANK, num_iterations=iterations,
+                       lambda_=LAMBDA, alpha=ALPHA, implicit_prefs=True,
+                       seed=3)
+
+    X32, Y32 = train_als(user_side, item_side, params)
+    p32 = precision_at_k(X32, Y32, rows, cols, held)
+    X16, Y16 = train_als(user_side, item_side,
+                         _dc.replace(params, precision="bf16"))
+    p16 = precision_at_k(X16, Y16, rows, cols, held)
+    return {
+        "check": "precision_policy_quality_gate",
+        "fp32_precision_at_10": round(p32, 4),
+        "bf16_precision_at_10": round(p16, 4),
+        "bf16_drop_abs": round(p32 - p16, 4),
+        "gate_max_drop_abs": 0.02,
+        "holdout_users": len(held),
+        "rank": RANK, "iterations": iterations,
+        "protocol": "leave-last-2-out per user>=5, top-10 unseen",
+    }
+
+
 def run_truncation_check(n_users: int = 6040, n_items: int = 3706,
                          nnz: int = 1_000_000, trunc_max_len: int = 512,
                          seed: int = 9) -> dict:

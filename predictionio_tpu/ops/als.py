@@ -62,6 +62,20 @@ class ALSParams(Params):
     # peak the same way solve_block_rows does for the uniform path.
     # None = solve each bucket in one dispatch.
     bucket_slot_budget: Optional[int] = None
+    # precision policy for the training loop: "fp32" (default —
+    # byte-identical to the historical all-fp32 path) or "bf16" (factor
+    # matrices stored and gathered as bfloat16, halving the dominant
+    # [B, L, R] HBM stream; the normal-equation einsums and shared Gram
+    # matrix accumulate in fp32 via preferred_element_type and the
+    # batched Cholesky solve stays fp32 — the ALX §4 storage/compute
+    # split). PIO_ALS_PRECISION overrides; resolved once per train_als*
+    # call (never at trace time) and unknown values raise.
+    precision: str = "fp32"
+    # one fp32 iterative-refinement pass on each normal-equation solve
+    # (x += solve(A, b - A x)): tightens the solve residual when the
+    # assembled A/b carry bf16 rounding, at ~2x solve cost. Off by
+    # default; meaningful mainly under precision="bf16".
+    solve_refine: bool = False
 
 
 @dataclasses.dataclass
@@ -401,8 +415,80 @@ def zero_empty_rows(X, mask):
     return X * has_any[:, None]
 
 
+PRECISION_MODES = ("fp32", "bf16")
+
+
+def normalize_precision(value: str, source: str) -> str:
+    """Canonicalize a precision string to ``fp32``/``bf16`` (accepting
+    the ``float32``/``bfloat16`` aliases) or raise naming ``source`` —
+    the ONE place the mode whitelist lives, shared by the training
+    (``PIO_ALS_PRECISION``) and serving (``PIO_SERVE_PRECISION``)
+    resolvers."""
+    mode = {"float32": "fp32", "bfloat16": "bf16"}.get(value, value)
+    if mode not in PRECISION_MODES:
+        raise ValueError(
+            f"{source}={mode!r} is not a known precision mode "
+            f"(expected one of: fp32, bf16)")
+    return mode
+
+
+def _als_precision_mode(params: Optional[ALSParams] = None) -> str:
+    """``fp32`` (the historical all-fp32 pipeline, byte-identical
+    default) or ``bf16`` (bf16 factor storage/gather, fp32 accumulation
+    and solve — ALX §4). ``PIO_ALS_PRECISION`` overrides
+    ``ALSParams.precision``; an unknown value raises instead of being
+    silently ignored. Resolved ONCE per ``train_als*`` call and passed
+    down as a static jit argument — never read at trace time, so
+    changing the env var between trainings always takes effect (same
+    contract as ``_spd_solver_mode``)."""
+    import os
+
+    forced = os.environ.get("PIO_ALS_PRECISION", "").strip().lower()
+    if forced:
+        return normalize_precision(forced, "PIO_ALS_PRECISION")
+    mode = str(getattr(params, "precision", None)
+               or "fp32").strip().lower()
+    return normalize_precision(mode, "ALSParams.precision")
+
+
+def factor_dtype(precision: str):
+    """The on-device factor storage dtype for a resolved precision mode."""
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if precision == "bf16" else jnp.float32
+
+
+def init_policy_factors(n_rows: int, n_cols: int, rank: int,
+                        seed: Optional[int], dtype,
+                        precision: str) -> Tuple:
+    """:func:`init_factors` under the precision policy: the random draw
+    always happens in the caller's ``dtype`` (fp32 by default), and
+    only THEN casts to the bf16 factor store — both precision lanes
+    start from (near-)identical factors, so differential suites isolate
+    the solve numerics, not the RNG's dtype behavior. Shared by every
+    ``train_als*`` entry point."""
+    X, Y = init_factors(n_rows, n_cols, rank, seed, dtype)
+    if precision == "bf16" and dtype is None:
+        X, Y = X.astype(factor_dtype(precision)), \
+            Y.astype(factor_dtype(precision))
+    return X, Y
+
+
+def _refine_solve(A, b, X, solver: Optional[str]):
+    """One fp32 iterative-refinement pass: x += solve(A, b - A x).
+    Tightens the residual left by bf16-rounded A/b assembly (the solve
+    itself is already fp32 either way)."""
+    import jax
+    import jax.numpy as jnp
+
+    r = b - jnp.einsum("brs,bs->br", A, X,
+                       precision=jax.lax.Precision.HIGHEST)
+    return X + _spd_solve(A, r, solver)
+
+
 def _solve_rows(Y, cols, weights, mask, lam: float, alpha: float,
-                implicit: bool, gram=None, solver: Optional[str] = None):
+                implicit: bool, gram=None, solver: Optional[str] = None,
+                precision: str = "fp32", refine: bool = False):
     """Normal-equation solve for one batch of rows: given fixed factors
     ``Y [M, R]`` and padded ratings ``[B, L]`` (+ validity mask), return
     new factors ``[B, R]``. ``gram`` (``Y^T Y``, implicit term) may be
@@ -411,12 +497,25 @@ def _solve_rows(Y, cols, weights, mask, lam: float, alpha: float,
     jit-friendly: static shapes, two einsums + batched Cholesky; runs on
     the MXU. Written to be shard_map-compatible: only ``cols``/``weights``/
     ``mask`` carry the batch dimension.
+
+    ``precision="bf16"``: ``Y`` is stored bfloat16, so the dominant
+    ``[B, L, R]`` gather moves half the HBM bytes; the confidence
+    weights are computed in fp32 then cast to bf16 so the MXU multiplies
+    native bf16 operands while ``preferred_element_type`` keeps the
+    normal-equation accumulators fp32; the batched Cholesky solve stays
+    fp32 and the new factors cast back to bf16 (ALX §4's
+    storage/compute split). ``"fp32"`` is byte-identical to the
+    historical path.
     """
     import jax
     import jax.numpy as jnp
 
     R = Y.shape[1]
     Yg = jnp.take(Y, cols, axis=0)            # [B, L, R] gather
+    if precision == "bf16":
+        X = _solve_rows_bf16(Y, Yg, weights, mask, lam, alpha, implicit,
+                             gram, solver, refine)
+        return zero_empty_rows(X, mask.astype(X.dtype))
     mask = mask.astype(Y.dtype)
     w = weights.astype(Y.dtype) * mask        # zero out padded slots
     # Normal equations are precision-sensitive: force full fp32 MXU passes
@@ -446,7 +545,45 @@ def _solve_rows(Y, cols, weights, mask, lam: float, alpha: float,
         b = jnp.einsum("bl,blr->br", w, Yg, precision=hi)
 
     X = _spd_solve(A, b, solver)
+    if refine:
+        X = _refine_solve(A, b, X, solver)
     return zero_empty_rows(X, mask)
+
+
+def _solve_rows_bf16(Y, Yg, weights, mask, lam: float, alpha: float,
+                     implicit: bool, gram, solver: Optional[str],
+                     refine: bool):
+    """The bf16 lane of :func:`_solve_rows`: bf16 operands into every
+    MXU pass, fp32 accumulators out (``preferred_element_type``), fp32
+    solve, result cast back to bf16 factor storage."""
+    import jax.numpy as jnp
+
+    f32, bf16 = jnp.float32, jnp.bfloat16
+    R = Y.shape[1]
+    mask32 = mask.astype(f32)
+    w32 = weights.astype(f32) * mask32        # zero out padded slots
+    if implicit:
+        aw, bw = implicit_weights(w32, alpha)
+        if gram is None:
+            gram = jnp.matmul(Y.T, Y, preferred_element_type=f32)
+        corr = jnp.einsum("bl,blr,bls->brs", aw.astype(bf16), Yg, Yg,
+                          preferred_element_type=f32)            # [B, R, R]
+        A = gram[None, :, :].astype(f32) + corr
+        A += lam * jnp.eye(R, dtype=f32)[None, :, :]
+        b = jnp.einsum("bl,blr->br", bw.astype(bf16), Yg,
+                       preferred_element_type=f32)               # [B, R]
+    else:
+        A = jnp.einsum("bl,blr,bls->brs", mask32.astype(bf16), Yg, Yg,
+                       preferred_element_type=f32)
+        n_b = jnp.sum(mask32, axis=1)                            # [B]
+        A += (lam * jnp.maximum(n_b, 1.0))[:, None, None] \
+            * jnp.eye(R, dtype=f32)[None, :, :]
+        b = jnp.einsum("bl,blr->br", w32.astype(bf16), Yg,
+                       preferred_element_type=f32)
+    X = _spd_solve(A, b, solver)
+    if refine:
+        X = _refine_solve(A, b, X, solver)
+    return X.astype(Y.dtype)
 
 
 def _spd_solver_mode() -> str:
@@ -598,15 +735,17 @@ def spd_solve_lanes(A, b, panel: int = 8):
 
 
 def _solve_side(Y, cols, weights, mask, lam: float, alpha: float,
-                implicit: bool, solver: Optional[str] = None):
+                implicit: bool, solver: Optional[str] = None,
+                precision: str = "fp32", refine: bool = False):
     """One uniform-table alternating half-step (all rows, one batch)."""
     return _solve_rows(Y, cols, weights, mask, lam, alpha, implicit,
-                       solver=solver)
+                       solver=solver, precision=precision, refine=refine)
 
 
 def _solve_side_blocked(Y, cols, weights, mask, lam: float, alpha: float,
                         implicit: bool, block: Optional[int],
-                        solver: Optional[str] = None):
+                        solver: Optional[str] = None,
+                        precision: str = "fp32", refine: bool = False):
     """`_solve_side`, optionally over sequential row blocks (lax.map) so
     the [block, L, R] gather — the HBM peak — is bounded regardless of
     row count. Caller guarantees rows % block == 0 (train_als pads)."""
@@ -615,12 +754,13 @@ def _solve_side_blocked(Y, cols, weights, mask, lam: float, alpha: float,
     B, L = cols.shape
     if not block or B <= block:
         return _solve_side(Y, cols, weights, mask, lam, alpha, implicit,
-                           solver)
+                           solver, precision, refine)
     nb = B // block
 
     def one(args):
         c, w, m = args
-        return _solve_side(Y, c, w, m, lam, alpha, implicit, solver)
+        return _solve_side(Y, c, w, m, lam, alpha, implicit, solver,
+                           precision, refine)
 
     X = jax.lax.map(one, (cols.reshape(nb, block, L),
                           weights.reshape(nb, block, L),
@@ -630,7 +770,7 @@ def _solve_side_blocked(Y, cols, weights, mask, lam: float, alpha: float,
 
 def _als_iterations_impl(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m, *, lam,
                          alpha, implicit, num_iterations, block=None,
-                         solver=None):
+                         solver=None, precision="fp32", refine=False):
     """Full training loop as one compiled program (lax.scan over
     iterations; no data-dependent Python control flow)."""
     import jax
@@ -638,9 +778,9 @@ def _als_iterations_impl(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m, *, lam,
     def body(carry, _):
         X, Y = carry
         X = _solve_side_blocked(Y, u_cols, u_w, u_m, lam, alpha, implicit,
-                                block, solver)
+                                block, solver, precision, refine)
         Y = _solve_side_blocked(X, i_cols, i_w, i_m, lam, alpha, implicit,
-                                block, solver)
+                                block, solver, precision, refine)
         return (X, Y), None
 
     (X, Y), _ = jax.lax.scan(body, (X, Y), None, length=num_iterations)
@@ -652,9 +792,14 @@ _als_iterations_jit = None
 
 def _als_iterations(*args, **kw):
     """Lazily-jitted wrapper (keeps jax out of storage-only imports).
-    ``solver`` is a STATIC argument: callers resolve the mode at call
-    time, so an env-var change retriggers compilation instead of being
-    baked in at first trace."""
+    ``solver``/``precision`` are STATIC arguments: callers resolve the
+    modes at call time, so an env-var change retriggers compilation
+    instead of being baked in at first trace.
+
+    The X/Y carries (args 0/1) are DONATED: steady-state training
+    iterations write the new factors into the input buffers' HBM
+    instead of copying two ``[N, R]`` matrices per dispatch — callers
+    must treat the factor arrays they pass in as consumed."""
     global _als_iterations_jit
     if _als_iterations_jit is None:
         import jax
@@ -662,14 +807,16 @@ def _als_iterations(*args, **kw):
         _als_iterations_jit = jax.jit(
             _als_iterations_impl,
             static_argnames=("lam", "alpha", "implicit", "num_iterations",
-                             "block", "solver"))
+                             "block", "solver", "precision", "refine"),
+            donate_argnums=(0, 1))
     return _als_iterations_jit(*args, **kw)
 
 
 def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
                          alpha: float, implicit: bool,
                          slot_budget: Optional[int],
-                         solver: Optional[str] = None):
+                         solver: Optional[str] = None,
+                         precision: str = "fp32", refine: bool = False):
     """One alternating half-step over length buckets: each bucket is a
     batched solve at its own ``L`` (one Gram matrix shared by all), and
     the results scatter into the full factor matrix. Rows in no bucket
@@ -683,8 +830,13 @@ def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
     import jax.numpy as jnp
 
     R = Y.shape[1]
-    hi = jax.lax.Precision.HIGHEST
-    gram = jnp.matmul(Y.T, Y, precision=hi) if implicit else None
+    if precision == "bf16":
+        # one shared fp32-accumulated Gram from the bf16 factor store
+        gram = jnp.matmul(Y.T, Y, preferred_element_type=jnp.float32) \
+            if implicit else None
+    else:
+        gram = jnp.matmul(Y.T, Y, precision=jax.lax.Precision.HIGHEST) \
+            if implicit else None
     X = jnp.zeros((n_rows_out, R), Y.dtype)
     for row_ids, cols, w, m in buckets:
         B, L = cols.shape
@@ -702,7 +854,7 @@ def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
             def one(args, _gram=gram):
                 c_, w_, m_ = args
                 return _solve_rows(Y, c_, w_, m_, lam, alpha, implicit,
-                                   _gram, solver)
+                                   _gram, solver, precision, refine)
 
             Xb = jax.lax.map(one, (cols.reshape(nb, block, L),
                                    w.reshape(nb, block, L),
@@ -710,7 +862,7 @@ def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
             Xb = Xb.reshape(B + pad, R)
         else:
             Xb = _solve_rows(Y, cols, w, m, lam, alpha, implicit, gram,
-                             solver)
+                             solver, precision, refine)
         # pad rows carry the sentinel row_id == n_rows_out -> dropped
         X = X.at[row_ids].set(Xb, mode="drop")
     return X
@@ -718,7 +870,8 @@ def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
 
 def _als_iterations_bucketed_impl(X, Y, u_buckets, i_buckets, *, lam,
                                   alpha, implicit, num_iterations,
-                                  slot_budget, solver=None):
+                                  slot_budget, solver=None,
+                                  precision="fp32", refine=False):
     """Bucketed training loop as one compiled program (lax.scan over
     iterations; the per-bucket solves are unrolled in the trace — a
     handful of static shapes, not data-dependent control flow)."""
@@ -729,9 +882,9 @@ def _als_iterations_bucketed_impl(X, Y, u_buckets, i_buckets, *, lam,
     def body(carry, _):
         X, Y = carry
         X = _solve_side_bucketed(Y, u_buckets, n_u, lam, alpha, implicit,
-                                 slot_budget, solver)
+                                 slot_budget, solver, precision, refine)
         Y = _solve_side_bucketed(X, i_buckets, n_i, lam, alpha, implicit,
-                                 slot_budget, solver)
+                                 slot_budget, solver, precision, refine)
         return (X, Y), None
 
     (X, Y), _ = jax.lax.scan(body, (X, Y), None, length=num_iterations)
@@ -742,6 +895,9 @@ _als_iterations_bucketed_jit = None
 
 
 def _als_iterations_bucketed(*args, **kw):
+    """Jitted bucketed loop; like :func:`_als_iterations` the X/Y
+    carries are donated (steady-state iterations reuse the factor HBM)
+    and ``solver``/``precision`` arrive resolved as static args."""
     global _als_iterations_bucketed_jit
     if _als_iterations_bucketed_jit is None:
         import jax
@@ -749,7 +905,9 @@ def _als_iterations_bucketed(*args, **kw):
         _als_iterations_bucketed_jit = jax.jit(
             _als_iterations_bucketed_impl,
             static_argnames=("lam", "alpha", "implicit", "num_iterations",
-                             "slot_budget", "solver"))
+                             "slot_budget", "solver", "precision",
+                             "refine"),
+            donate_argnums=(0, 1))
     return _als_iterations_bucketed_jit(*args, **kw)
 
 
@@ -767,8 +925,9 @@ def train_als_bucketed(user_side: BucketedRatings,
     once when training repeatedly."""
     assert user_side.n_rows >= item_side.n_cols
     assert item_side.n_rows >= user_side.n_cols
-    X, Y = init_factors(user_side.n_rows, item_side.n_rows, params.rank,
-                        params.seed, dtype)
+    precision = _als_precision_mode(params)  # resolved per call
+    X, Y = init_policy_factors(user_side.n_rows, item_side.n_rows,
+                               params.rank, params.seed, dtype, precision)
     as_tuples = lambda s: tuple(  # noqa: E731
         (b.row_ids, b.cols, b.weights, b.mask) for b in s.buckets)
     X, Y = _als_iterations_bucketed(
@@ -778,8 +937,12 @@ def train_als_bucketed(user_side: BucketedRatings,
         num_iterations=int(params.num_iterations),
         slot_budget=None if not params.bucket_slot_budget
         else int(params.bucket_slot_budget),
-        solver=_spd_solver_mode())  # resolved per call, never at trace
-    return np.asarray(X), np.asarray(Y)
+        solver=_spd_solver_mode(),  # resolved per call, never at trace
+        precision=precision, refine=bool(params.solve_refine))
+    # host factors always land fp32: persistence, serving and the eval
+    # stack stay byte-compatible regardless of the training policy
+    return (np.asarray(X, dtype=np.float32),
+            np.asarray(Y, dtype=np.float32))
 
 
 def init_factors(n_rows: int, n_cols: int, rank: int,
@@ -820,9 +983,10 @@ def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
         # counts then come from n_valid_rows.
         user_side = pad_rows_to_block(user_side, block)
         item_side = pad_rows_to_block(item_side, block)
+    precision = _als_precision_mode(params)  # resolved per call
     n_u, n_i = user_side.valid_rows, item_side.valid_rows
-    X, Y = init_factors(user_side.n_rows, item_side.n_rows, params.rank,
-                        params.seed, dtype)
+    X, Y = init_policy_factors(user_side.n_rows, item_side.n_rows,
+                               params.rank, params.seed, dtype, precision)
     if n_u < user_side.n_rows or n_i < item_side.n_rows:
         # the random init filled the pad rows too — zero them NOW, or the
         # first half-iteration's shared Gram term (Y^T Y over all rows,
@@ -841,8 +1005,11 @@ def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
         implicit=bool(params.implicit_prefs),
         num_iterations=int(params.num_iterations),
         block=None if not block else int(block),
-        solver=_spd_solver_mode())  # resolved per call, never at trace
-    return np.asarray(X)[:n_u], np.asarray(Y)[:n_i]
+        solver=_spd_solver_mode(),  # resolved per call, never at trace
+        precision=precision, refine=bool(params.solve_refine))
+    # host factors always land fp32 (see train_als_bucketed)
+    return (np.asarray(X, dtype=np.float32)[:n_u],
+            np.asarray(Y, dtype=np.float32)[:n_i])
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,45 @@ import numpy as np
 from predictionio_tpu.utils.tracing import span as _trace_span
 
 
+def _serve_precision_mode() -> str:
+    """Serving factor-store precision: ``fp32`` (default) or ``bf16``
+    (item/user factor matrices held in HBM as bfloat16 — half the
+    scoring HBM stream; every scoring matmul still accumulates fp32 via
+    ``preferred_element_type``, so returned scores stay float32).
+    ``PIO_SERVE_PRECISION`` opts in; unknown values raise (one shared
+    whitelist with the training-side ``PIO_ALS_PRECISION`` policy).
+    Resolved at server construction."""
+    import os
+
+    mode = os.environ.get("PIO_SERVE_PRECISION", "").strip().lower()
+    if not mode:
+        return "fp32"
+    from predictionio_tpu.ops.als import normalize_precision
+
+    return normalize_precision(mode, "PIO_SERVE_PRECISION")
+
+
+def _is_bf16(arr) -> bool:
+    """dtype check that works for jax Arrays AND ml_dtypes-backed numpy."""
+    return getattr(getattr(arr, "dtype", None), "name", "") == "bfloat16"
+
+
+def _score_einsum(subscripts: str, *operands):
+    """Scoring matmul under the serving precision policy: fp32 factors
+    keep the historical full-precision MXU passes; bf16 factors feed the
+    MXU natively with an fp32 accumulator (``preferred_element_type``) —
+    either way the result is float32 (``_pack`` and the -inf masking
+    depend on it)."""
+    import jax
+    import jax.numpy as jnp
+
+    if any(_is_bf16(op) for op in operands):
+        return jnp.einsum(subscripts, *operands,
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum(subscripts, *operands,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
 def seen_tables(seen: Dict[int, np.ndarray], n_rows: int,
                 pad_multiple: int = 8) -> Tuple[np.ndarray, np.ndarray]:
     """Pack a ``{user_idx: item_idx array}`` dict into padded
@@ -95,8 +134,7 @@ def _user_topk(X, Y, seen_cols, seen_mask, uid, *, k: int, mask_seen: bool,
     import jax.numpy as jnp
 
     u = jax.lax.dynamic_index_in_dim(X, uid, axis=0, keepdims=False)
-    scores = jnp.einsum("mr,r->m", Y, u,
-                        precision=jax.lax.Precision.HIGHEST)
+    scores = _score_einsum("mr,r->m", Y, u)
     if mask_seen:
         sc = jax.lax.dynamic_index_in_dim(seen_cols, uid, 0, keepdims=False)
         sm = jax.lax.dynamic_index_in_dim(seen_mask, uid, 0, keepdims=False)
@@ -113,10 +151,11 @@ def _items_topk(Yn, idx, idx_mask, *, k: int, n_items: int):
     import jax
     import jax.numpy as jnp
 
-    hi = jax.lax.Precision.HIGHEST
     qf = jnp.take(Yn, idx, axis=0)                    # [B, R]
-    scores = jnp.einsum("mr,br->m", Yn, qf * idx_mask[:, None],
-                        precision=hi)
+    # mask in the factor dtype: an fp32 mask would silently promote a
+    # bf16 qf off the native-bf16 MXU path
+    qm = qf * idx_mask[:, None].astype(Yn.dtype)
+    scores = _score_einsum("mr,br->m", Yn, qm)
     # the query items themselves never recommend (mask to -inf)
     scores = scores.at[idx].add(
         jnp.where(idx_mask > 0, -jnp.inf, 0.0), mode="drop")
@@ -124,13 +163,18 @@ def _items_topk(Yn, idx, idx_mask, *, k: int, n_items: int):
 
 
 def _normalize_rows(Y):
+    """Row-normalize, computing the norms in fp32 regardless of the
+    factor storage dtype (a bf16 norm would square bf16 values); the
+    result keeps Y's dtype so bf16 stores stay half-width in HBM."""
     import jax
     import jax.numpy as jnp
 
     @jax.jit
     def norm(Y):
-        return Y / jnp.maximum(
-            jnp.linalg.norm(Y, axis=1, keepdims=True), 1e-12)
+        Yf = Y.astype(jnp.float32)
+        return (Yf / jnp.maximum(
+            jnp.linalg.norm(Yf, axis=1, keepdims=True),
+            1e-12)).astype(Y.dtype)
 
     return norm(Y)
 
@@ -166,6 +210,14 @@ class HostTopK:
                  n_items: Optional[int] = None):
         self._X = np.asarray(user_factors)
         self._Y = np.asarray(item_factors)
+        if _is_bf16(self._X):
+            # bf16 models (ALX-style training under PIO_ALS_PRECISION=
+            # bf16, device-resident flavors gathered to host) serve on
+            # host in fp32: numpy has no native bf16 BLAS, and at host-
+            # servable sizes the memory halving buys nothing
+            self._X = self._X.astype(np.float32)
+        if _is_bf16(self._Y):
+            self._Y = self._Y.astype(np.float32)
         self.n_users = int(n_users if n_users is not None
                            else self._X.shape[0])
         self.n_items = int(n_items if n_items is not None
@@ -242,11 +294,17 @@ def choose_server(user_factors, item_factors,
       enough that a numpy matvec beats a device round trip
       (< HOST_SERVE_MAX_ELEMS item-factor elements); DeviceTopK otherwise.
 
+    ``PIO_SERVE_PRECISION=bf16`` opts the device store into bfloat16
+    factors (fp32 score accumulation); it forces the device backend in
+    auto mode — the policy is an HBM policy and means nothing on host —
+    and conflicts loudly with an explicit ``host`` backend.
+
     Device-resident (sharded) models never go through this — their
     factors live only in HBM and always serve via DeviceTopK."""
     import os
 
     backend = os.environ.get("PIO_SERVING_BACKEND", "auto").lower()
+    bf16_serve = _serve_precision_mode() == "bf16"
     host_capable = not (hasattr(user_factors, "sharding")
                         or hasattr(item_factors, "sharding"))
     if backend == "host":
@@ -254,8 +312,13 @@ def choose_server(user_factors, item_factors,
             raise ValueError(
                 "PIO_SERVING_BACKEND=host but the factors are "
                 "device-resident jax Arrays")
+        if bf16_serve:
+            raise ValueError(
+                "PIO_SERVE_PRECISION=bf16 conflicts with "
+                "PIO_SERVING_BACKEND=host: the bf16 store is a device "
+                "(HBM) policy; host serving is always fp32")
         cls = HostTopK
-    elif backend == "device":
+    elif backend == "device" or bf16_serve:
         cls = DeviceTopK
     else:
         small = (np.asarray(item_factors).size <= HOST_SERVE_MAX_ELEMS
@@ -489,6 +552,15 @@ class DeviceTopK:
                    else jnp.asarray(user_factors))
         self._Y = (item_factors if hasattr(item_factors, "sharding")
                    else jnp.asarray(item_factors))
+        if _serve_precision_mode() == "bf16":
+            # opt-in bf16 factor store: halves the HBM the model holds
+            # AND the bytes every scoring matmul streams; the cast
+            # preserves an existing mesh sharding (elementwise program).
+            # Scores still accumulate + return fp32 (_score_einsum).
+            if not _is_bf16(self._X):
+                self._X = self._X.astype(jnp.bfloat16)
+            if not _is_bf16(self._Y):
+                self._Y = self._Y.astype(jnp.bfloat16)
         # factor tables may be padded (sharded training pads rows);
         # n_users/n_items bound the valid index range
         self.n_users = int(n_users if n_users is not None

@@ -29,7 +29,10 @@ from predictionio_tpu.ops.als import (
     RatingsBucket,
     _als_iterations_bucketed_impl,
     _als_iterations_impl,
-    init_factors,
+    _als_precision_mode,
+    _spd_solver_mode,
+    factor_dtype,
+    init_policy_factors,
 )
 
 
@@ -45,15 +48,19 @@ def _jit_step(mesh, factor_spec):
     """The production jitted iteration program: factor outputs pinned to
     ``factor_spec`` between iterations; XLA inserts the collectives
     (all-gather before each index-gather — the ICI analog of MLlib's
-    factor shuffle)."""
+    factor shuffle). The X/Y carries are donated — input and output
+    shardings match, so steady-state steps update the factor shards in
+    place instead of copying them per dispatch."""
     import jax
     from jax.sharding import NamedSharding
 
     factor_sharded = NamedSharding(mesh, factor_spec)
     return jax.jit(
         _als_iterations_impl,
-        static_argnames=("lam", "alpha", "implicit", "num_iterations"),
+        static_argnames=("lam", "alpha", "implicit", "num_iterations",
+                         "solver", "precision", "refine"),
         out_shardings=(factor_sharded, factor_sharded),
+        donate_argnums=(0, 1),
     )
 
 
@@ -75,8 +82,9 @@ def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    X, Y = init_factors(user_side.n_rows, user_side.n_cols, params.rank,
-                        params.seed, dtype)
+    precision = _als_precision_mode(params)  # resolved per call
+    X, Y = init_policy_factors(user_side.n_rows, user_side.n_cols,
+                               params.rank, params.seed, dtype, precision)
     n_u = -(-user_side.n_rows // row_divisor) * row_divisor
     n_i = -(-item_side.n_rows // row_divisor) * row_divisor
 
@@ -124,10 +132,13 @@ def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
     X, Y = step(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m,
                 lam=float(params.lambda_), alpha=float(params.alpha),
                 implicit=bool(params.implicit_prefs),
-                num_iterations=int(params.num_iterations))
+                num_iterations=int(params.num_iterations),
+                solver=_spd_solver_mode(),  # resolved per call
+                precision=precision, refine=bool(params.solve_refine))
     if not gather:
         # PAlgorithm path: factors STAY sharded in HBM (padded to n_u/n_i
-        # rows); the caller serves from them directly (ops/serving.py)
+        # rows, bf16 under the bf16 policy); the caller serves from them
+        # directly (ops/serving.py accepts bf16 factor Arrays)
         return X, Y
     if multi_host:
         # factors are needed host-side on every host (model persistence,
@@ -136,8 +147,9 @@ def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
 
         X = multihost_utils.process_allgather(X, tiled=True)
         Y = multihost_utils.process_allgather(Y, tiled=True)
-    return (np.asarray(X)[:user_side.n_rows],
-            np.asarray(Y)[:item_side.n_rows])
+    # host factors always land fp32 (see ops.als.train_als)
+    return (np.asarray(X, dtype=np.float32)[:user_side.n_rows],
+            np.asarray(Y, dtype=np.float32)[:item_side.n_rows])
 
 
 def train_als_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
@@ -289,8 +301,9 @@ def train_als_bucketed_sharded(user_side: BucketedRatings,
                         place_arr(b.mask, rows_sharded, P("data", None))))
         return tuple(out)
 
-    X, Y = init_factors(user_side.n_rows, item_side.n_rows, params.rank,
-                        params.seed, dtype)
+    precision = _als_precision_mode(params)  # resolved per call
+    X, Y = init_policy_factors(user_side.n_rows, item_side.n_rows,
+                               params.rank, params.seed, dtype, precision)
     # a sharded factor dim must split evenly: pad rows (with ZEROS — a
     # random-init pad row would pollute the first shared Gram term) to
     # the dim-0 axis product; pad rows are never scattered into by a
@@ -315,21 +328,26 @@ def train_als_bucketed_sharded(user_side: BucketedRatings,
     fn = jax.jit(
         _als_iterations_bucketed_impl,
         static_argnames=("lam", "alpha", "implicit", "num_iterations",
-                         "slot_budget"),
-        out_shardings=(repl, repl))
+                         "slot_budget", "solver", "precision", "refine"),
+        out_shardings=(repl, repl),
+        donate_argnums=(0, 1))
     X, Y = fn(X, Y, place(user_side), place(item_side),
               lam=float(params.lambda_), alpha=float(params.alpha),
               implicit=bool(params.implicit_prefs),
               num_iterations=int(params.num_iterations),
               slot_budget=None if not params.bucket_slot_budget
-              else int(params.bucket_slot_budget))
+              else int(params.bucket_slot_budget),
+              solver=_spd_solver_mode(),  # resolved per call
+              precision=precision, refine=bool(params.solve_refine))
     if not gather:
         # PAlgorithm flavor: factors stay in HBM in their sharded
-        # placement (rows padded to the factor divisor); serve via
-        # ops.serving.DeviceTopK with the true n_users/n_items bounds
+        # placement (rows padded to the factor divisor, bf16 under the
+        # bf16 policy); serve via ops.serving.DeviceTopK with the true
+        # n_users/n_items bounds
         return X, Y
-    return (np.asarray(X)[:user_side.n_rows],
-            np.asarray(Y)[:item_side.n_rows])
+    # host factors always land fp32 (see ops.als.train_als)
+    return (np.asarray(X, dtype=np.float32)[:user_side.n_rows],
+            np.asarray(Y, dtype=np.float32)[:item_side.n_rows])
 
 
 def train_als_auto(user_side, item_side, params: ALSParams, dtype=None
@@ -385,16 +403,23 @@ def sharded_train_step(mesh, rank: int, params: Optional[ALSParams] = None):
 
     fn = jax.jit(
         _als_iterations_impl,
-        static_argnames=("lam", "alpha", "implicit", "num_iterations"),
+        static_argnames=("lam", "alpha", "implicit", "num_iterations",
+                         "solver", "precision", "refine"),
         out_shardings=(replicated, replicated),
+        donate_argnums=(0, 1),
     )
 
     def run(X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m):
         import jax.numpy as jnp
 
         put = jax.device_put
-        return fn(put(jnp.asarray(X), replicated),
-                  put(jnp.asarray(Y), replicated),
+        precision = _als_precision_mode(params)  # resolved per call
+        # the caller's host factors enter in the policy's storage dtype
+        # — under bf16 the step must actually exercise the half-width
+        # gather, not a mongrel fp32-store/bf16-weights lane
+        fdt = factor_dtype(precision)
+        return fn(put(jnp.asarray(X, dtype=fdt), replicated),
+                  put(jnp.asarray(Y, dtype=fdt), replicated),
                   put(jnp.asarray(u_cols), row_sharded),
                   put(jnp.asarray(u_w), row_sharded),
                   put(jnp.asarray(u_m), row_sharded),
@@ -403,6 +428,9 @@ def sharded_train_step(mesh, rank: int, params: Optional[ALSParams] = None):
                   put(jnp.asarray(i_m), row_sharded),
                   lam=float(params.lambda_), alpha=float(params.alpha),
                   implicit=bool(params.implicit_prefs),
-                  num_iterations=1)
+                  num_iterations=1,
+                  solver=_spd_solver_mode(),  # resolved per call
+                  precision=precision,
+                  refine=bool(params.solve_refine))
 
     return run, {"rows": row_sharded, "factors": replicated}

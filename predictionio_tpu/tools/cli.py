@@ -89,6 +89,15 @@ def _add_tracing_args(p: argparse.ArgumentParser) -> None:
                         "to $PIO_TRACE_DIR when set")
 
 
+def _add_serve_precision_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--serve-precision", choices=("fp32", "bf16"),
+                   default=None,
+                   help="serving factor-store precision (default fp32; "
+                        "env PIO_SERVE_PRECISION). bf16 halves the "
+                        "model's HBM and scoring traffic; scores still "
+                        "accumulate fp32")
+
+
 def _add_distributed_args(p: argparse.ArgumentParser) -> None:
     """Multi-host topology flags (the spark-submit cluster plane analog,
     Runner.scala:92-210; see parallel/distributed.py for the launch
@@ -181,6 +190,13 @@ def build_parser() -> argparse.ArgumentParser:
                        help="write a jax.profiler trace of the train pass "
                             "here (TensorBoard/Perfetto); defaults to "
                             "$PIO_PROFILE_DIR when set")
+    train.add_argument("--precision", choices=("fp32", "bf16"),
+                       default=None,
+                       help="ALS training precision policy (default "
+                            "fp32 — bit-stable historical path; env "
+                            "PIO_ALS_PRECISION). bf16 stores/gathers "
+                            "factors as bfloat16 with fp32 "
+                            "normal-equation accumulation and solve")
     _add_engine_args(train)
     train.add_argument("--batch", default="")
     train.add_argument("--skip-sanity-check", action="store_true")
@@ -212,6 +228,7 @@ def build_parser() -> argparse.ArgumentParser:
                           "./server.json)")
     _add_metrics_arg(dep)
     _add_tracing_args(dep)
+    _add_serve_precision_arg(dep)
     dep.set_defaults(func=run_commands.cmd_deploy)
 
     bp = sub.add_parser(
@@ -254,6 +271,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "and verify — ignores the other flags")
     _add_metrics_arg(bp)
     _add_tracing_args(bp)
+    _add_serve_precision_arg(bp)
     bp.set_defaults(func=run_commands.cmd_batchpredict)
 
     undep = sub.add_parser("undeploy", help="stop a deployed engine server")

@@ -91,6 +91,20 @@ def _apply_tracing_flags(args) -> None:
         tracing.set_trace_dir(trace_dir)
 
 
+def _apply_precision_flags(args) -> None:
+    """--precision -> $PIO_ALS_PRECISION, --serve-precision ->
+    $PIO_SERVE_PRECISION. The env vars are the single source of truth
+    the per-call resolvers (ops/als.py, ops/serving.py) read, so the
+    flags override engine.json params the same way the operator-set env
+    would; None leaves any ambient env value in place."""
+    precision = getattr(args, "precision", None)
+    if precision:
+        os.environ["PIO_ALS_PRECISION"] = precision
+    serve_precision = getattr(args, "serve_precision", None)
+    if serve_precision:
+        os.environ["PIO_SERVE_PRECISION"] = serve_precision
+
+
 def cmd_train(args) -> int:
     """Console train (Console.scala:834-842) -> create_workflow. A
     profile dir (--profile-dir / $PIO_PROFILE_DIR) captures a
@@ -103,6 +117,7 @@ def cmd_train(args) -> int:
     from predictionio_tpu.utils.tracing import profile_trace, trace_scope
 
     _apply_tracing_flags(args)
+    _apply_precision_flags(args)
     try:
         # multi-host runtime (no-op on one host; parallel/distributed.py)
         from predictionio_tpu.parallel import distributed
@@ -197,6 +212,7 @@ def cmd_deploy(args) -> int:
 
     _apply_metrics_flag(args)
     _apply_tracing_flags(args)
+    _apply_precision_flags(args)
     if args.feedback and not args.accesskey:
         # CreateServer.scala:452-455: feedback requires an access key
         print("[ERROR] Feedback loop cannot be enabled because accessKey "
@@ -249,6 +265,7 @@ def cmd_batchpredict(args) -> int:
 
     _apply_metrics_flag(args)
     _apply_tracing_flags(args)
+    _apply_precision_flags(args)
     if args.smoke:
         return run_smoke()
     if not args.output:

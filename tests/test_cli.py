@@ -527,3 +527,92 @@ class TestTemplateAndLifecycleVerbs:
         assert len(rows) == 1
         assert rows[0].evaluation_class == (
             "tests.cli_eval_fixture:make_evaluation")
+
+
+class TestPrecisionFlags:
+    """--precision / --serve-precision plumbing (the CLI arm of the
+    ops/als.py + ops/serving.py precision policy) and the bench device
+    watchdog's configurable-deadline skip artifact."""
+
+    def test_unknown_precision_value_rejected(self, capsys):
+        # argparse choices: a typo'd lane must never reach training
+        with pytest.raises(SystemExit):
+            main(["train", "--precision", "fp16"])
+        with pytest.raises(SystemExit):
+            main(["deploy", "--serve-precision", "int8"])
+
+    def test_train_precision_flag_sets_env(self, mem_storage, tmp_path,
+                                           capsys, monkeypatch):
+        """--precision bf16 lands in PIO_ALS_PRECISION, the single
+        source of truth the per-call resolver reads — so the flag
+        affects the very training the command runs."""
+        import json
+        import os
+
+        # setenv("") (not delenv): cmd_train writes os.environ directly,
+        # so monkeypatch must have a recorded value to restore — an
+        # empty string resolves to the default lane either way
+        monkeypatch.setenv("PIO_ALS_PRECISION", "")
+        engine_dir = tmp_path / "precengine"
+        assert main(["template", "get", "recommendation",
+                     str(engine_dir)]) == 0
+        TestTemplateAndLifecycleVerbs().seed("precapp")
+        variant_path = engine_dir / "engine.json"
+        variant = json.loads(variant_path.read_text())
+        variant["datasource"]["params"]["appName"] = "precapp"
+        variant_path.write_text(json.dumps(variant))
+        capsys.readouterr()
+        assert main(["train", "--engine-variant", str(variant_path),
+                     "--precision", "bf16"]) == 0
+        assert os.environ.get("PIO_ALS_PRECISION") == "bf16"
+        assert "Training completed" in capsys.readouterr().out
+
+    def test_serve_precision_flag_sets_env(self, monkeypatch):
+        from predictionio_tpu.tools.run_commands import (
+            _apply_precision_flags,
+        )
+
+        import argparse
+        import os
+
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "")
+        _apply_precision_flags(argparse.Namespace(serve_precision="bf16"))
+        assert os.environ.get("PIO_SERVE_PRECISION") == "bf16"
+
+    def test_bench_watchdog_skip_artifact_is_immediate(self):
+        """A probe that FAILS fast (dead tunnel refusing, not hanging)
+        must emit the skip artifact immediately — not burn the full
+        PIO_BENCH_DEVICE_TIMEOUT deadline, and not exit artifact-less
+        (BENCH_r05 regression)."""
+        import json
+        import os
+        import subprocess
+        import sys
+        import time
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "bogus"  # backend init raises fast
+        env["PIO_BENCH_DEVICE_TIMEOUT"] = "120"
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import bench; bench._device_watchdog()"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__))),
+            env=env, capture_output=True, text=True, timeout=110)
+        took = time.monotonic() - t0
+        assert proc.returncode == 3
+        assert took < 60, f"skip artifact took {took:.0f}s"
+        artifact = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert artifact["metric"] == \
+            "als_implicit_ml100k_rank64_events_per_sec"
+        assert artifact["value"] == 0
+        assert "failed immediately" in artifact["error"]
+
+    def test_bench_watchdog_timeout_env_override(self, monkeypatch):
+        """PIO_BENCH_DEVICE_TIMEOUT configures the hang deadline; a
+        healthy backend returns well inside it."""
+        import bench
+
+        monkeypatch.setenv("PIO_BENCH_DEVICE_TIMEOUT", "45")
+        bench._device_watchdog()  # healthy CPU backend: returns

@@ -434,6 +434,112 @@ class TestHostTopK:
             choose_server(jnp.asarray(X), jnp.asarray(Y), seen)
 
 
+class TestServePrecision:
+    """PIO_SERVE_PRECISION=bf16 opt-in: bfloat16 factor store in HBM,
+    fp32 score accumulation, gated on top-k agreement with the fp32
+    server (the serving arm of the ops/als.py precision policy)."""
+
+    @pytest.fixture()
+    def separated(self):
+        """Factors whose score gaps (>= 1.0 between item ranks, score
+        magnitudes <= ~40) dwarf bf16 rounding (~0.15 at that scale):
+        the bf16 server must return the identical top-k ordering."""
+        rng = np.random.default_rng(11)
+        n_users, n_items, rank = 12, 40, 8
+        X = np.zeros((n_users, rank), dtype=np.float32)
+        X[:, 0] = 1.0
+        X[:, 1] = rng.uniform(-0.01, 0.01, size=n_users)
+        Y = rng.uniform(-0.01, 0.01, size=(n_items, rank)) \
+            .astype(np.float32)
+        # item i scores ~ i + noise<<1 for every user, in every user's
+        # ranking — well separated at any k
+        Y[:, 0] = np.arange(n_items, dtype=np.float32)
+        return X, Y
+
+    def test_unknown_value_raises(self, monkeypatch):
+        from predictionio_tpu.ops.serving import _serve_precision_mode
+
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "fp8")
+        with pytest.raises(ValueError, match="PIO_SERVE_PRECISION"):
+            _serve_precision_mode()
+
+    def test_bf16_store_and_fp32_scores(self, separated, monkeypatch):
+        X, Y = separated
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "bf16")
+        srv = DeviceTopK(X, Y)
+        assert srv._X.dtype == np.dtype("bfloat16").newbyteorder("=") \
+            or str(srv._X.dtype) == "bfloat16"
+        idx, scores = srv.user_topk(0, 10)
+        assert scores.dtype == np.float32
+
+    def test_topk_overlap_with_fp32_server(self, separated, monkeypatch):
+        X, Y = separated
+        monkeypatch.delenv("PIO_SERVE_PRECISION", raising=False)
+        ref = DeviceTopK(X, Y)
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "bf16")
+        srv = DeviceTopK(X, Y)
+        for uid in range(X.shape[0]):
+            ri, rs = ref.user_topk(uid, 10)
+            bi, bs = srv.user_topk(uid, 10)
+            assert ri.tolist() == bi.tolist()
+            np.testing.assert_allclose(bs, rs, rtol=0.02, atol=0.2)
+        # batched path agrees too
+        ri, _ = ref.users_topk(np.arange(8), 10)
+        bi, _ = srv.users_topk(np.arange(8), 10)
+        np.testing.assert_array_equal(ri, bi)
+
+    def test_items_topk_overlap(self, separated, monkeypatch):
+        X, _ = separated
+        # planar items at designed angles: the two query items sit at
+        # m +- 0.3 rad, every candidate at m + 0.13*(i-1) — summed
+        # cosine is 2*cos(0.3)*cos(angle - m), so ranking follows the
+        # angular offsets with score gaps >= ~0.02, an order of
+        # magnitude above bf16 rounding of unit vectors
+        m = 0.8
+        phi = np.array([m - 0.3, m + 0.3]
+                       + [m + 0.13 * i for i in range(1, 23)])
+        Y = np.zeros((24, 8), dtype=np.float32)
+        Y[:, 0] = np.cos(phi)
+        Y[:, 1] = np.sin(phi)
+        monkeypatch.delenv("PIO_SERVE_PRECISION", raising=False)
+        ref = DeviceTopK(X, Y)
+        ri, _ = ref.items_topk([0, 1], 5)
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "bf16")
+        srv = DeviceTopK(X, Y)
+        bi, bs = srv.items_topk([0, 1], 5)
+        assert ri.tolist() == bi.tolist()
+        assert np.isfinite(bs).all()
+
+    def test_choose_server_forces_device_backend(self, monkeypatch):
+        from predictionio_tpu.ops.serving import choose_server
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(10, 4)).astype(np.float32)
+        Y = rng.normal(size=(12, 4)).astype(np.float32)
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "bf16")
+        monkeypatch.delenv("PIO_SERVING_BACKEND", raising=False)
+        # auto would pick HostTopK at this size; bf16 is an HBM policy
+        assert isinstance(choose_server(X, Y), DeviceTopK)
+        monkeypatch.setenv("PIO_SERVING_BACKEND", "host")
+        with pytest.raises(ValueError, match="PIO_SERVE_PRECISION"):
+            choose_server(X, Y)
+
+    def test_host_server_accepts_bf16_factors(self, monkeypatch):
+        """Gathered bf16 models (ml_dtypes numpy) still serve on host:
+        HostTopK casts to fp32 (numpy has no bf16 BLAS)."""
+        import ml_dtypes
+
+        from predictionio_tpu.ops.serving import HostTopK
+
+        monkeypatch.delenv("PIO_SERVE_PRECISION", raising=False)
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(10, 4)).astype(ml_dtypes.bfloat16)
+        Y = rng.normal(size=(12, 4)).astype(ml_dtypes.bfloat16)
+        srv = HostTopK(X, Y)
+        idx, scores = srv.user_topk(0, 5)
+        assert len(idx) == 5 and np.isfinite(scores).all()
+
+
 def _seed(app_name="recapp"):
     aid = storage.get_metadata_apps().insert(App(0, app_name))
     le = storage.get_levents()
