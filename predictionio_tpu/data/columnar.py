@@ -171,6 +171,20 @@ class ColumnarEvents:
         )
 
 
+def _unique_codes(codes: np.ndarray, n_labels: int):
+    """``np.unique(codes, return_inverse=True)`` for NON-NEGATIVE codes
+    bounded by a (small) label-table size: O(n + k) presence scan +
+    table lookup instead of an O(n log n) sort — the ingest consumer's
+    hottest per-block step. Same contract: sorted distinct codes, and
+    the inverse mapping into them."""
+    present = np.zeros(n_labels, dtype=bool)
+    present[codes] = True
+    uniq = np.flatnonzero(present)
+    remap = np.empty(n_labels, dtype=np.int64)
+    remap[uniq] = np.arange(len(uniq))
+    return uniq, remap[codes]
+
+
 class StreamingRatingsBuilder:
     """Incremental (user, item, value) triple builder over columnar
     blocks — the ≥10M-rating ingest core (SURVEY hard part #2).
@@ -233,8 +247,10 @@ class StreamingRatingsBuilder:
                 vals = np.asarray(block.values, dtype=np.float32)
             if not len(ecodes):
                 return
-            uniq_e, inv_e = np.unique(ecodes, return_inverse=True)
-            uniq_t, inv_t = np.unique(tcodes, return_inverse=True)
+            uniq_e, inv_e = _unique_codes(ecodes,
+                                          len(block.entity_labels))
+            uniq_t, inv_t = _unique_codes(tcodes,
+                                          len(block.target_labels))
             self._rows.append(self._merge_labels(
                 block.entity_labels[uniq_e], self._users)[inv_e])
             self._cols.append(self._merge_labels(
@@ -276,6 +292,237 @@ class StreamingRatingsBuilder:
         vals = (np.concatenate(self._vals) if self._vals
                 else np.empty(0, dtype=np.float32))
         return user_map, item_map, rows, cols, vals
+
+
+class PipelinedRatingsBuilder(StreamingRatingsBuilder):
+    """StreamingRatingsBuilder whose consumer stage also PRE-SORTS each
+    block's triples by their packed (row, col) key as blocks arrive —
+    the per-block share of the dedup sort, done inside the
+    decode/index overlap window. :meth:`finalize_bucketed` then
+    replaces the monolithic O(N log N) argsort over the full COO
+    arrays with a stable O(N log k) k-way merge of the already-sorted
+    runs (native kernel, GIL released) and feeds both solve sides'
+    bucket scatter + async H2D staging from it.
+
+    Byte-identity with the serial path is by construction: the merge
+    permutation equals ``np.argsort(key, kind="stable")`` over the
+    stream-ordered triples (per-block stable sorts + stable merge keep
+    every duplicate pair's stream order), and the dedup summation and
+    bucket scatter are the very same code the serial
+    ``bucket_ratings_pair`` runs.
+
+    Note :meth:`finalize` (the uniform-path contract) returns triples
+    in merged (row, col) order rather than stream order — the same
+    multiset, and identical training inputs for every consumer that
+    dedups (pad_ratings / bucket_ratings_pair both do). A consumer
+    that is sensitive to raw triple ORDER (e.g. a leave-last-out eval
+    split) must use :class:`StreamingRatingsBuilder` instead."""
+
+    def add_block(self, block: ColumnarEvents) -> None:
+        runs_before = len(self._rows)
+        super().add_block(block)
+        if len(self._rows) == runs_before:
+            return  # block empty or fully filtered
+        r, c = self._rows[-1], self._cols[-1]
+        # rows fit 31 bits at any realistic entity count; cols 32
+        key = (r << np.int64(32)) | c
+        order = np.argsort(key, kind="stable")
+        self._rows[-1] = r[order]
+        self._cols[-1] = c[order]
+        self._vals[-1] = self._vals[-1][order]
+
+    def merge_sorted(self):
+        """-> (rows, cols, vals, keys) globally stable-sorted by
+        (row, col): the k-way merge of the per-block sorted runs
+        (``keys`` is the sorted packed key array — callers feed it to
+        the dedup without re-packing). Equal keys keep stream order, so
+        :func:`ops.als.dedup_sum_sorted` sums duplicates in exactly the
+        serial path's order."""
+        from predictionio_tpu.native import codec as _native
+
+        if not self._rows:
+            z = np.empty(0, dtype=np.int64)
+            return z, z.copy(), np.empty(0, dtype=np.float32), z.copy()
+        rows = np.concatenate(self._rows)
+        cols = np.concatenate(self._cols)
+        vals = np.concatenate(self._vals)
+        keys = (rows << np.int64(32)) | cols
+        if len(self._rows) > 1:
+            offsets = np.zeros(len(self._rows) + 1, dtype=np.int64)
+            np.cumsum([len(a) for a in self._rows], out=offsets[1:])
+            perm = _native.merge_sorted_runs(keys, offsets)
+            if perm is None:  # no native lib: same permutation, full sort
+                perm = np.argsort(keys, kind="stable")
+            rows, cols, vals, keys = \
+                rows[perm], cols[perm], vals[perm], keys[perm]
+        return rows, cols, vals, keys
+
+    def finalize(self):
+        """Uniform-path contract (user_map, item_map, rows, cols,
+        values) — triples arrive merged-sorted, not stream-ordered."""
+        from predictionio_tpu.data.bimap import StringIndexBiMap
+
+        user_map = StringIndexBiMap.from_distinct(list(self._users))
+        item_map = StringIndexBiMap.from_distinct(list(self._items))
+        rows, cols, vals, _ = self.merge_sorted()
+        return user_map, item_map, rows, cols, vals
+
+    def finalize_bucketed(self, bucket_lengths=None, max_len=None,
+                          pad_multiple: int = 8, row_multiple: int = 8,
+                          stage_device: bool = False, device=None,
+                          warmup_params=None,
+                          timeline=None) -> "PipelinedIngestResult":
+        """Merge + dedup + bucketize both solve sides, overlapping each
+        side's async H2D transfer with the other side's host scatter
+        (and, when ``warmup_params`` is given, with the bucketed
+        training program's AOT compile on a background thread).
+
+        Identical bucket layouts to
+        ``ops.als.bucket_ratings_pair(rows, cols, vals, ...)`` over the
+        stream-ordered triples."""
+        import threading as _threading
+
+        from predictionio_tpu.data.bimap import StringIndexBiMap
+        from predictionio_tpu.ops import als as _als
+        from predictionio_tpu.utils.tracing import (
+            StageTimeline,
+            current_trace_context,
+        )
+
+        timeline = timeline if timeline is not None else StageTimeline()
+        parent = current_trace_context()
+        user_map = StringIndexBiMap.from_distinct(list(self._users))
+        item_map = StringIndexBiMap.from_distinct(list(self._items))
+        n_u, n_i = len(user_map), len(item_map)
+        with timeline.scope("merge", parent):
+            rows, cols, vals, key = self.merge_sorted()
+            rows, cols, vals = _als.dedup_sum_sorted(key, rows, cols,
+                                                     vals)
+        with timeline.scope("bucket.user", parent):
+            user_side = _als._bucket_grouped(
+                rows, cols, vals, n_u, n_i, bucket_lengths, max_len,
+                pad_multiple, row_multiple)
+        nnz = int(len(rows))
+        user_host = user_side
+        if stage_device:
+            # user side's transfers stream WHILE the item side's
+            # re-sort + scatter runs on host (double buffering)
+            with timeline.scope("h2d.user.dispatch", parent):
+                user_side = user_side.to_device_async(device)
+        with timeline.scope("bucket.item", parent):
+            o = np.argsort(cols, kind="stable")
+            item_side = _als._bucket_grouped(
+                cols[o], rows[o], vals[o], n_i, n_u, bucket_lengths,
+                max_len, pad_multiple, row_multiple)
+        item_host = item_side
+        if stage_device:
+            with timeline.scope("h2d.item.dispatch", parent):
+                item_side = item_side.to_device_async(device)
+        warmup_thread = None
+        if warmup_params is not None:
+            # compile hides inside the transfer window; shapes come
+            # from the host-side structures so no transfer is awaited
+            def _warm():
+                with timeline.scope("warmup_compile", parent):
+                    _als.warmup_train_als_bucketed(user_host, item_host,
+                                                   warmup_params)
+
+            warmup_thread = _threading.Thread(
+                target=_warm, daemon=True, name="pio-ingest-warmup")
+            warmup_thread.start()
+        return PipelinedIngestResult(
+            user_map=user_map, item_map=item_map, user_side=user_side,
+            item_side=item_side, n_events=self.n_events, nnz=nnz,
+            staged=bool(stage_device), timeline=timeline,
+            _warmup_thread=warmup_thread)
+
+
+@dataclasses.dataclass
+class PipelinedIngestResult:
+    """Everything the training step needs, plus the overlap evidence.
+
+    ``user_side``/``item_side`` are :class:`~predictionio_tpu.ops.als.
+    BucketedRatings`; with ``staged`` their tables are device arrays
+    whose H2D transfers may still be in flight — call :meth:`wait`
+    (idempotent) before timing-sensitive work, or just train (jax
+    serializes on the data)."""
+
+    user_map: object
+    item_map: object
+    user_side: object
+    item_side: object
+    n_events: int
+    nnz: int
+    staged: bool
+    timeline: object
+    _warmup_thread: object = None
+
+    def wait(self, warmup: bool = True) -> "PipelinedIngestResult":
+        """``warmup=False`` closes only the H2D window (ingest is
+        done); the compile tail then belongs to the first training
+        call — join it there via :meth:`join_warmup`."""
+        from predictionio_tpu.utils.tracing import current_trace_context
+
+        parent = current_trace_context()
+        if self.staged:
+            with self.timeline.scope("h2d.wait", parent):
+                self.user_side.block_until_staged()
+                self.item_side.block_until_staged()
+        if warmup:
+            self.join_warmup()
+        return self
+
+    def join_warmup(self) -> "PipelinedIngestResult":
+        """Wait for the background AOT compile (no-op without one);
+        train right after and the executable is already cached."""
+        if self._warmup_thread is not None:
+            from predictionio_tpu.utils.tracing import (
+                current_trace_context,
+            )
+
+            with self.timeline.scope("warmup_wait",
+                                     current_trace_context()):
+                self._warmup_thread.join()
+            self._warmup_thread = None
+        return self
+
+
+def ingest_ratings_pipelined(blocks, queue_size: int = 4,
+                             bucket_lengths=None, max_len=None,
+                             pad_multiple: int = 8, row_multiple: int = 8,
+                             stage_device: bool = False, device=None,
+                             warmup_params=None,
+                             timeline=None) -> PipelinedIngestResult:
+    """The overlapped ingest pipeline, end to end: drive ``blocks`` (a
+    ColumnarEvents iterator, e.g. ``find_columnar_blocks``) on a
+    producer thread through a bounded queue; index + block-sort each
+    block on the consumer as it arrives; then merge/dedup/bucketize
+    with each side's H2D transfer (and the optional training-program
+    warm-up compile) overlapping the remaining host work. Returns a
+    :class:`PipelinedIngestResult`; call ``.wait()`` to close the
+    overlap window.
+
+    Training inputs are byte-identical to the serial
+    ``StreamingRatingsBuilder`` + ``bucket_ratings_pair`` chain — see
+    :class:`PipelinedRatingsBuilder`."""
+    from predictionio_tpu.utils.tracing import (
+        StageTimeline,
+        current_trace_context,
+    )
+
+    timeline = timeline if timeline is not None else StageTimeline()
+    parent = current_trace_context()
+    builder = PipelinedRatingsBuilder()
+    timed_blocks = timeline.wrap_iter(blocks, "decode", parent)
+    for block in iter_blocks_threaded(timed_blocks,
+                                      queue_size=queue_size):
+        with timeline.scope("index", parent):
+            builder.add_block(block)
+    return builder.finalize_bucketed(
+        bucket_lengths=bucket_lengths, max_len=max_len,
+        pad_multiple=pad_multiple, row_multiple=row_multiple,
+        stage_device=stage_device, device=device,
+        warmup_params=warmup_params, timeline=timeline)
 
 
 def iter_blocks_threaded(block_iter, queue_size: int = 4):

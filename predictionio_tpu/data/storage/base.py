@@ -267,6 +267,7 @@ class PEvents(abc.ABC):
         default_value: float = 1.0,
         strict: bool = True,
         block_size: int = 1_000_000,
+        prefetch: int = 0,
     ):
         """Streaming bulk scan: yields :class:`ColumnarEvents` blocks of at
         most ``block_size`` rows, in STORAGE order (not time order) — the
@@ -274,7 +275,12 @@ class PEvents(abc.ABC):
         way: per time range ``JDBCPEvents.scala:31-100``, per HBase region
         ``HBPEvents.scala:83-89``). Backends override so a block's memory
         is bounded; this default slices one materialized scan and only
-        bounds what downstream consumers hold."""
+        bounds what downstream consumers hold.
+
+        ``prefetch`` is a read-ahead HINT (how many storage units the
+        backend may read/decode ahead of the consumer, trading memory
+        for decode parallelism); backends without a natural unit ignore
+        it — block order and content never change."""
         batch = self.find_columnar(
             app_id=app_id, channel_id=channel_id, start_time=start_time,
             until_time=until_time, entity_type=entity_type,

@@ -346,12 +346,16 @@ class RestPEvents(base.LEventsBackedPEvents):
                              until_time=None, entity_type=None,
                              event_names=None, target_entity_type=UNSET,
                              value_property=None, default_value=1.0,
-                             strict=True, block_size=1_000_000):
+                             strict=True, block_size=1_000_000,
+                             prefetch=0):
         """Fetch the UNFILTERED raw stream (for a jsonlfs-backed server:
         partition bytes, no server-side parsing) in ~8MB bites split at
         line boundaries, decode each with the native codec, and apply
         the filters over dictionary codes — the remote analog of the
-        jsonlfs partition scan."""
+        jsonlfs partition scan. ``prefetch`` is accepted but ignored:
+        the wire stream is already pipelined by TCP readahead and
+        decode happens per bite on this side."""
+        del prefetch
         from predictionio_tpu.data.storage.jsonlfs import decode_jsonl_events
 
         BITE = 8 << 20

@@ -800,12 +800,16 @@ class SqlitePEvents(base.LEventsBackedPEvents):
                              until_time=None, entity_type=None,
                              event_names=None, target_entity_type=UNSET,
                              value_property=None, default_value=1.0,
-                             strict=True, block_size=1_000_000):
+                             strict=True, block_size=1_000_000,
+                             prefetch=0):
         """Streaming scan via rowid keyset pagination — fixed-size
         columnar blocks in storage (rowid) order, never materializing the
         whole result set (the JDBCPEvents.scala:31-100 partitioned-read
         analog). Falls back to the generic sliced scan for exotic
-        property names (same reason as find_columnar)."""
+        property names (same reason as find_columnar). ``prefetch`` is
+        accepted but ignored: one connection, one cursor — there is no
+        decode stage to run ahead."""
+        del prefetch
         if value_property is not None and '"' in value_property:
             yield from super().find_columnar_blocks(
                 app_id, channel_id=channel_id, start_time=start_time,

@@ -118,18 +118,21 @@ class PEventStore:
         default_value: float = 1.0,
         strict: bool = True,
         block_size: int = 1_000_000,
+        prefetch: int = 0,
     ):
         """Streaming bulk read: ColumnarEvents blocks in storage order —
         the ≥10M-event ingest path (partitioned reads like
         JDBCPEvents.scala:31-100 / HBPEvents.scala:83-89; backends bound
-        per-block memory)."""
+        per-block memory). ``prefetch`` hints how far the backend may
+        read/decode ahead (jsonlfs: that many partitions in parallel);
+        backends without a natural unit ignore it."""
         app_id, channel_id = app_name_to_id(app_name, channel_name)
         return storage.get_pevents().find_columnar_blocks(
             app_id=app_id, channel_id=channel_id, start_time=start_time,
             until_time=until_time, entity_type=entity_type,
             event_names=event_names, target_entity_type=target_entity_type,
             value_property=value_property, default_value=default_value,
-            strict=strict, block_size=block_size)
+            strict=strict, block_size=block_size, prefetch=prefetch)
 
 
 class LEventStoreTimeoutError(TimeoutError):

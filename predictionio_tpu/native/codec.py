@@ -260,3 +260,111 @@ def parse_jsonl(data: bytes,
         return parsed
     finally:
         lib.pio_jsonl_free(handle)
+
+
+# ---------------------------------------------------------------------------
+# Ingest kernels — vectorized merge/pad/bucketize host passes
+# (lib ingest_kernels; the 35s monolithic bucketize pass of BENCH_r04).
+# Each wrapper returns None when the native lib is unavailable; callers
+# fall back to the byte-identical numpy path.
+# ---------------------------------------------------------------------------
+
+def _ingest_lib():
+    lib = native.load("ingest_kernels")
+    # signatures (re)applied per CDLL instance, as in _lib()
+    if lib is not None and not getattr(lib, "_pio_sigs", False):
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.pio_merge_runs_i64.restype = None
+        lib.pio_merge_runs_i64.argtypes = [
+            i64p, i64p, ctypes.c_int32, ctypes.c_int64, i64p]
+        lib.pio_bucket_fill.restype = None
+        lib.pio_bucket_fill.argtypes = [
+            ctypes.c_int64, i64p, i64p,
+            ctypes.POINTER(ctypes.c_float), i64p,
+            ctypes.POINTER(ctypes.c_int32), i64p, ctypes.c_int32, i64p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float))]
+        lib.pio_segment_starts_i64.restype = ctypes.c_int64
+        lib.pio_segment_starts_i64.argtypes = [i64p, ctypes.c_int64, i64p]
+        lib._pio_sigs = True
+    return lib
+
+
+def ingest_kernels_available() -> bool:
+    return _ingest_lib() is not None
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def merge_sorted_runs(keys: np.ndarray,
+                      offsets: np.ndarray) -> Optional[np.ndarray]:
+    """Stable k-way merge permutation over contiguous sorted int64 runs
+    (run r = ``keys[offsets[r]:offsets[r+1]]``, each ascending).
+    Bit-identical to ``np.argsort(keys, kind="stable")``; O(N log k)
+    instead of a full sort, and the GIL is released for the whole merge.
+    None when the native lib is unavailable."""
+    lib = _ingest_lib()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = int(keys.shape[0])
+    perm = np.empty(n, dtype=np.int64)
+    lib.pio_merge_runs_i64(_i64p(keys), _i64p(offsets),
+                           len(offsets) - 1, n, _i64p(perm))
+    return perm
+
+
+def segment_starts(sorted_keys: np.ndarray) -> Optional[np.ndarray]:
+    """Start index of each equal-key segment in a SORTED int64 array —
+    the grouping step of dedup-sum (identical to
+    ``np.flatnonzero(np.r_[True, k[1:] != k[:-1]])``). None when the
+    native lib is unavailable."""
+    lib = _ingest_lib()
+    if lib is None:
+        return None
+    sorted_keys = np.ascontiguousarray(sorted_keys, dtype=np.int64)
+    n = int(sorted_keys.shape[0])
+    out = np.empty(max(1, n), dtype=np.int64)
+    m = lib.pio_segment_starts_i64(_i64p(sorted_keys), n, _i64p(out))
+    return out[:m]
+
+
+def bucket_fill(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                pos: np.ndarray, b_of_row: np.ndarray, rank: np.ndarray,
+                tables) -> bool:
+    """One-pass scatter of row-sorted deduped triples into per-bucket
+    padded tables (``tables`` = list of ``(cols_i32, w_f32, m_f32)``
+    C-contiguous zeroed arrays, one per bucket, each ``[Bp, L_b]``).
+    Pure data movement — byte-identical to the numpy per-bucket
+    mask+scatter, but one pass over N instead of one per bucket.
+    False when the native lib is unavailable (caller uses numpy)."""
+    lib = _ingest_lib()
+    if lib is None:
+        return False
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    cols = np.ascontiguousarray(cols, dtype=np.int64)
+    vals = np.ascontiguousarray(vals, dtype=np.float32)
+    pos = np.ascontiguousarray(pos, dtype=np.int64)
+    b_of_row = np.ascontiguousarray(b_of_row, dtype=np.int32)
+    rank = np.ascontiguousarray(rank, dtype=np.int64)
+    nb = len(tables)
+    L = np.asarray([t[0].shape[1] for t in tables], dtype=np.int64)
+    c_pp = (ctypes.POINTER(ctypes.c_int32) * nb)(*[
+        t[0].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        for t in tables])
+    w_pp = (ctypes.POINTER(ctypes.c_float) * nb)(*[
+        t[1].ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        for t in tables])
+    m_pp = (ctypes.POINTER(ctypes.c_float) * nb)(*[
+        t[2].ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        for t in tables])
+    lib.pio_bucket_fill(
+        len(rows), _i64p(rows), _i64p(cols),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), _i64p(pos),
+        b_of_row.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _i64p(rank), nb, _i64p(L), c_pp, w_pp, m_pp)
+    return True

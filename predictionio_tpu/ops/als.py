@@ -128,12 +128,30 @@ def dedup_sum_ratings(rows: np.ndarray, cols: np.ndarray,
         return rows, cols, values
     key = rows * n_cols + cols
     order = np.argsort(key, kind="stable")
-    k = key[order]
-    starts = np.flatnonzero(np.r_[True, k[1:] != k[:-1]])
-    sums = np.add.reduceat(values[order], starts).astype(np.float32)
-    uniq = k[starts]
-    return (uniq // n_cols).astype(np.int64), \
-        (uniq % n_cols).astype(np.int64), sums
+    return dedup_sum_sorted(key[order], rows[order], cols[order],
+                            values[order])
+
+
+def dedup_sum_sorted(key: np.ndarray, rows: np.ndarray, cols: np.ndarray,
+                     values: np.ndarray):
+    """The dedup-sum tail over triples ALREADY stably sorted by the
+    (row, col) key: segment starts + one ``np.add.reduceat`` per run.
+    Shared by :func:`dedup_sum_ratings` (which sorts first) and the
+    pipelined ingest's k-way merge finalize (whose merge produces the
+    identical stable order without the global sort) — one summation
+    code path, so both lanes are byte-identical by construction."""
+    if not len(rows):
+        return (np.asarray(rows, dtype=np.int64),
+                np.asarray(cols, dtype=np.int64),
+                np.asarray(values, dtype=np.float32))
+    from predictionio_tpu.native import codec as _native
+
+    starts = _native.segment_starts(key)
+    if starts is None:
+        starts = np.flatnonzero(np.r_[True, key[1:] != key[:-1]])
+    sums = np.add.reduceat(values, starts).astype(np.float32)
+    return (rows[starts].astype(np.int64),
+            cols[starts].astype(np.int64), sums)
 
 
 def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
@@ -175,9 +193,17 @@ def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
     out_cols = np.zeros((n_rows, L), dtype=np.int32)
     out_w = np.zeros((n_rows, L), dtype=np.float32)
     out_m = np.zeros((n_rows, L), dtype=np.float32)
-    out_cols[rows, pos] = cols
-    out_w[rows, pos] = values
-    out_m[rows, pos] = 1.0
+    from predictionio_tpu.native import codec as _native
+
+    # the uniform table is the one-bucket case of the native fill
+    # kernel (row rank == row index); numpy scatter as fallback
+    if not _native.bucket_fill(rows, cols, values, pos,
+                               np.zeros(n_rows, dtype=np.int32),
+                               np.arange(n_rows, dtype=np.int64),
+                               [(out_cols, out_w, out_m)]):
+        out_cols[rows, pos] = cols
+        out_w[rows, pos] = values
+        out_m[rows, pos] = 1.0
     return PaddedRatings(out_cols, out_w, out_m, n_rows, n_cols)
 
 
@@ -267,15 +293,39 @@ class BucketedRatings:
 
     def to_device(self) -> "BucketedRatings":
         """New BucketedRatings whose tables live in HBM (the numpy
-        original stays untouched); transfer once, train many."""
-        import jax.numpy as jnp
+        original stays untouched); transfer once, train many. Blocks
+        until every table has landed — :meth:`to_device_async` is the
+        overlapped flavor the pipelined ingest uses."""
+        return self.to_device_async().block_until_staged()
+
+    def to_device_async(self, device=None) -> "BucketedRatings":
+        """Start every bucket table's H2D transfer WITHOUT waiting for
+        completion: ``jax.device_put`` dispatches asynchronously, so the
+        caller keeps bucketizing the next table (or the other solve
+        side) on host while these bytes stream — the double-buffering
+        half of the ingest pipeline. Call :meth:`block_until_staged`
+        (or just train) when the overlap window closes."""
+        import jax
+
+        def put(a):
+            return jax.device_put(a, device)
 
         return dataclasses.replace(self, buckets=[
             dataclasses.replace(
-                b, row_ids=jnp.asarray(b.row_ids),
-                cols=jnp.asarray(b.cols), weights=jnp.asarray(b.weights),
-                mask=jnp.asarray(b.mask))
+                b, row_ids=put(b.row_ids), cols=put(b.cols),
+                weights=put(b.weights), mask=put(b.mask))
             for b in self.buckets])
+
+    def block_until_staged(self) -> "BucketedRatings":
+        """Wait for all in-flight :meth:`to_device_async` transfers of
+        this instance's tables; returns self (host-numpy tables are a
+        no-op)."""
+        for b in self.buckets:
+            for a in (b.row_ids, b.cols, b.weights, b.mask):
+                wait = getattr(a, "block_until_ready", None)
+                if wait is not None:
+                    wait()
+        return self
 
 
 def bucket_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
@@ -367,9 +417,14 @@ def _bucket_grouped(rows, cols, values, n_rows: int, n_cols: int,
 
     eff = np.minimum(counts, L_top)
     b_of_row = np.searchsorted(lengths, eff, side="left")
-    b_of_entry = b_of_row[rows]
-    out: List[RatingsBucket] = []
     rank = np.empty(n_rows, dtype=np.int64)  # valid only at member rows
+    # allocate every bucket's zeroed tables first, then fill — either in
+    # ONE native pass over all entries (pio_bucket_fill: pure data
+    # movement, byte-identical) or with the per-bucket numpy scatter
+    # (one boolean pass over all entries PER bucket) as fallback
+    tables: List[tuple] = []
+    id_lists: List[np.ndarray] = []
+    table_of_bucket = np.full(len(lengths), -1, dtype=np.int32)
     for b, L in enumerate(lengths):
         members = np.nonzero((b_of_row == b) & (eff > 0))[0]
         if members.size == 0:
@@ -377,17 +432,32 @@ def _bucket_grouped(rows, cols, values, n_rows: int, n_cols: int,
         B = int(members.size)
         Bp = -(-B // row_multiple) * row_multiple
         rank[members] = np.arange(B)
-        sel = b_of_entry == b
-        r, c, v, p = rows[sel], cols[sel], values[sel], pos[sel]
         oc = np.zeros((Bp, L), dtype=np.int32)
         ow = np.zeros((Bp, L), dtype=np.float32)
         om = np.zeros((Bp, L), dtype=np.float32)
-        oc[rank[r], p] = c
-        ow[rank[r], p] = v
-        om[rank[r], p] = 1.0
         row_ids = np.full(Bp, n_rows, dtype=np.int32)  # pad sentinel
         row_ids[:B] = members
-        out.append(RatingsBucket(row_ids, oc, ow, om))
+        table_of_bucket[b] = len(tables)
+        tables.append((oc, ow, om))
+        id_lists.append(row_ids)
+    if tables:
+        from predictionio_tpu.native import codec as _native
+
+        if not _native.bucket_fill(rows, cols, values, pos,
+                                   table_of_bucket[b_of_row], rank,
+                                   tables):
+            b_of_entry = b_of_row[rows]
+            for b in range(len(lengths)):
+                ti = int(table_of_bucket[b])
+                if ti < 0:
+                    continue
+                oc, ow, om = tables[ti]
+                sel = b_of_entry == b
+                r, c, v, p = rows[sel], cols[sel], values[sel], pos[sel]
+                oc[rank[r], p] = c
+                ow[rank[r], p] = v
+                om[rank[r], p] = 1.0
+    out = [RatingsBucket(ids, *tbl) for ids, tbl in zip(id_lists, tables)]
     return BucketedRatings(out, n_rows, n_cols)
 
 
@@ -893,11 +963,41 @@ def _als_iterations_bucketed_impl(X, Y, u_buckets, i_buckets, *, lam,
 
 _als_iterations_bucketed_jit = None
 
+# AOT-compiled bucketed executables: abstract-signature key ->
+# jax Compiled. Populated by warmup_train_als_bucketed (typically on a
+# background thread overlapping H2D transfers); consulted by
+# _als_iterations_bucketed so the warmed first train skips its compile
+# wait entirely. Races are benign (worst case: one redundant compile).
+# Bounded FIFO: a long-lived process warming ever-new shapes must not
+# pin old executables (each holds device code).
+_aot_bucketed: dict = {}
+_AOT_BUCKETED_MAX = 8
 
-def _als_iterations_bucketed(*args, **kw):
-    """Jitted bucketed loop; like :func:`_als_iterations` the X/Y
-    carries are donated (steady-state iterations reuse the factor HBM)
-    and ``solver``/``precision`` arrive resolved as static args."""
+
+def _bucketed_aot_key(args, kw) -> tuple:
+    """Abstract signature of one bucketed training call: every leaf's
+    (shape, dtype, device ids) plus the static kwargs — what XLA would
+    key its compilation on. Device identity matters: the warm-up
+    lowers for the DEFAULT device (ShapeDtypeStructs carry none), so a
+    call whose tables were committed elsewhere must miss the cache and
+    take the jit path (which compiles for the right device) instead of
+    crashing the default-device executable."""
+    import jax
+
+    default_ids = (jax.devices()[0].id,)
+
+    def leaf_sig(a):
+        devs = getattr(a, "devices", None)
+        ids = (tuple(sorted(d.id for d in devs()))
+               if callable(devs) else default_ids)
+        return (tuple(a.shape), str(a.dtype), ids)
+
+    leaves = jax.tree_util.tree_leaves(args)
+    return (tuple(leaf_sig(a) for a in leaves),
+            tuple(sorted(kw.items())))
+
+
+def _get_bucketed_jit():
     global _als_iterations_bucketed_jit
     if _als_iterations_bucketed_jit is None:
         import jax
@@ -908,7 +1008,81 @@ def _als_iterations_bucketed(*args, **kw):
                              "slot_budget", "solver", "precision",
                              "refine"),
             donate_argnums=(0, 1))
-    return _als_iterations_bucketed_jit(*args, **kw)
+    return _als_iterations_bucketed_jit
+
+
+def _als_iterations_bucketed(*args, **kw):
+    """Jitted bucketed loop; like :func:`_als_iterations` the X/Y
+    carries are donated (steady-state iterations reuse the factor HBM)
+    and ``solver``/``precision`` arrive resolved as static args. A
+    matching AOT executable from :func:`warmup_train_als_bucketed`
+    (statics baked at lower time) is used when present."""
+    jitted = _get_bucketed_jit()
+    if _aot_bucketed:
+        compiled = _aot_bucketed.get(_bucketed_aot_key(args, kw))
+        if compiled is not None:
+            return compiled(*args)
+    return jitted(*args, **kw)
+
+
+def _bucketed_call_args(user_side: BucketedRatings,
+                        item_side: BucketedRatings, params: ALSParams,
+                        precision: str, abstract: bool = False):
+    """The exact (args, static kwargs) train_als_bucketed passes to the
+    jitted loop — shared with the AOT warm-up so a warmed signature is
+    guaranteed to match the real call. ``abstract=True`` replaces every
+    array with its ShapeDtypeStruct."""
+    import jax
+
+    def leaf(a):
+        return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) \
+            if abstract else a
+
+    as_tuples = lambda s: tuple(  # noqa: E731
+        (leaf(b.row_ids), leaf(b.cols), leaf(b.weights), leaf(b.mask))
+        for b in s.buckets)
+    if abstract:
+        dt = factor_dtype(precision)
+        X = jax.ShapeDtypeStruct((user_side.n_rows, int(params.rank)), dt)
+        Y = jax.ShapeDtypeStruct((item_side.n_rows, int(params.rank)), dt)
+    else:
+        X = Y = None  # caller inits real factors
+    args = (X, Y, as_tuples(user_side), as_tuples(item_side))
+    kw = dict(
+        lam=float(params.lambda_), alpha=float(params.alpha),
+        implicit=bool(params.implicit_prefs),
+        num_iterations=int(params.num_iterations),
+        slot_budget=None if not params.bucket_slot_budget
+        else int(params.bucket_slot_budget),
+        solver=_spd_solver_mode(), precision=precision,
+        refine=bool(params.solve_refine))
+    return args, kw
+
+
+def warmup_train_als_bucketed(user_side: BucketedRatings,
+                              item_side: BucketedRatings,
+                              params: ALSParams) -> bool:
+    """AOT-compile the bucketed training program for these exact bucket
+    shapes/statics so the next :func:`train_als_bucketed` call starts
+    computing immediately instead of paying its jit wait. The pipelined
+    ingest runs this on a background thread WHILE the bucket tables'
+    H2D transfers stream — compile time hides inside the transfer
+    window. Best-effort: returns False (and the normal jit path compiles
+    as before) if this jax version's AOT path declines."""
+    try:
+        precision = _als_precision_mode(params)
+        args, kw = _bucketed_call_args(user_side, item_side, params,
+                                       precision, abstract=True)
+        key = _bucketed_aot_key(args, kw)
+        if key in _aot_bucketed:
+            return True
+        compiled = _get_bucketed_jit().lower(*args, **kw).compile()
+        while len(_aot_bucketed) >= _AOT_BUCKETED_MAX:
+            _aot_bucketed.pop(next(iter(_aot_bucketed)))
+        _aot_bucketed[key] = compiled
+        return True
+    except Exception:
+        return False
 
 
 def train_als_bucketed(user_side: BucketedRatings,
@@ -928,17 +1102,11 @@ def train_als_bucketed(user_side: BucketedRatings,
     precision = _als_precision_mode(params)  # resolved per call
     X, Y = init_policy_factors(user_side.n_rows, item_side.n_rows,
                                params.rank, params.seed, dtype, precision)
-    as_tuples = lambda s: tuple(  # noqa: E731
-        (b.row_ids, b.cols, b.weights, b.mask) for b in s.buckets)
-    X, Y = _als_iterations_bucketed(
-        X, Y, as_tuples(user_side), as_tuples(item_side),
-        lam=float(params.lambda_), alpha=float(params.alpha),
-        implicit=bool(params.implicit_prefs),
-        num_iterations=int(params.num_iterations),
-        slot_budget=None if not params.bucket_slot_budget
-        else int(params.bucket_slot_budget),
-        solver=_spd_solver_mode(),  # resolved per call, never at trace
-        precision=precision, refine=bool(params.solve_refine))
+    # args/statics built by the SAME helper the AOT warm-up lowers
+    # with, so a warmed executable always matches this call's signature
+    (_, _, u_t, i_t), kw = _bucketed_call_args(user_side, item_side,
+                                               params, precision)
+    X, Y = _als_iterations_bucketed(X, Y, u_t, i_t, **kw)
     # host factors always land fp32: persistence, serving and the eval
     # stack stay byte-compatible regardless of the training policy
     return (np.asarray(X, dtype=np.float32),

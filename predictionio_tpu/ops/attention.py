@@ -71,8 +71,10 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
     # axis so the fori_loop carry type matches its per-device outputs.
     if hasattr(jax.lax, "pcast"):
         _vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
-    else:  # older jax
+    elif hasattr(jax.lax, "pvary"):
         _vary = lambda x: jax.lax.pvary(x, (axis_name,))
+    else:  # jax <= 0.4.x: no varying-type system — carries need no mark
+        _vary = lambda x: x
     o0 = _vary(jnp.zeros((B, H, L, D), dtype=jnp.float32))
     l0 = _vary(jnp.zeros((B, H, L), dtype=jnp.float32))
     m0 = _vary(jnp.full((B, H, L), -jnp.inf, dtype=jnp.float32))

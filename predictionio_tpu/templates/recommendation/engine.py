@@ -61,6 +61,13 @@ class DataSourceParams(Params):
     event_names: Tuple[str, ...] = ("rate",)
     channel_name: Optional[str] = None
     streaming_block_size: Optional[int] = None
+    # pipelined flavor of the streaming read: per-block sort while
+    # decode runs, merge-based finalize (identical training inputs,
+    # see data/columnar.PipelinedRatingsBuilder); decode_prefetch is
+    # passed to the backend as its read-ahead hint (jsonlfs decodes
+    # that many partitions in parallel)
+    pipelined_ingest: bool = False
+    decode_prefetch: int = 0
     # filter-by-category variant: also aggregate item $set categories so
     # queries can restrict recommendations to categories
     # (filter-by-category/.../DataSource.scala:60-79)
@@ -187,14 +194,32 @@ class EventDataSource(PDataSource):
     params_class = DataSourceParams
 
     def read_training(self, ctx: ComputeContext) -> Any:
+        return self._read_training(pipelined=None)
+
+    def _read_training(self, pipelined: Optional[bool]) -> Any:
+        """``pipelined=None`` follows params; ``False`` forces the
+        serial builder (read_eval: its leave-last-out split consumes
+        RAW triple order without dedup, and the pipelined finalize
+        returns merged (row, col) order — eval must see the same
+        stream order as the serial path)."""
         p: DataSourceParams = self.params
+        if p.pipelined_ingest and not p.streaming_block_size:
+            raise ValueError(
+                "pipelined_ingest requires streaming_block_size: the "
+                "pipelined builder consumes streamed columnar blocks "
+                "(set datasource {\"streamingBlockSize\": N} alongside "
+                "\"pipelinedIngest\": true)")
+        if pipelined is None:
+            pipelined = bool(p.pipelined_ingest)
         if p.streaming_block_size:
             from predictionio_tpu.data.columnar import (
+                PipelinedRatingsBuilder,
                 StreamingRatingsBuilder,
                 iter_blocks_threaded,
             )
 
-            builder = StreamingRatingsBuilder()
+            builder = (PipelinedRatingsBuilder() if pipelined
+                       else StreamingRatingsBuilder())
             # decode thread + indexing consumer overlap (bounded queue)
             for block in iter_blocks_threaded(
                     PEventStore.find_columnar_blocks(
@@ -205,7 +230,8 @@ class EventDataSource(PDataSource):
                         target_entity_type="item",
                         value_property="rating",
                         default_value=1.0,
-                        block_size=int(p.streaming_block_size))):
+                        block_size=int(p.streaming_block_size),
+                        prefetch=int(p.decode_prefetch))):
                 builder.add_block(block)
             td = IndexedTrainingData(*builder.finalize())
             td.item_categories = self._read_item_categories(p)
@@ -246,7 +272,10 @@ class EventDataSource(PDataSource):
         p: DataSourceParams = self.params
         if p.eval_count > 0:
             return self._sliding_eval(p)
-        td = self.read_training(ctx)
+        # serial builder even under pipelined_ingest: leave-last-out
+        # splits on raw triple ORDER, which the pipelined finalize
+        # does not preserve (merged (row, col) order)
+        td = self._read_training(pipelined=False)
         if isinstance(td, IndexedTrainingData):
             # eval works on typed ratings; decode the streamed triples
             td = TrainingData(users=td.user_map.decode(td.rows),

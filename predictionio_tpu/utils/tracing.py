@@ -932,6 +932,136 @@ def span(name: str, level: int = logging.DEBUG,
             logger.log(level, "%s took %.3fs", name, took)
 
 
+@contextlib.contextmanager
+def detached_span(name: str, parent: Optional[SpanContext] = None,
+                  attributes: Optional[Dict[str, Any]] = None):
+    """A child span parented EXPLICITLY under ``parent`` (a SpanContext
+    snapshot) instead of the ambient contextvar — for pipeline stages
+    that run on worker threads the context never crossed (e.g. the
+    ingest decode producer). Records into the trace buffer like any
+    span, so Perfetto renders the cross-thread overlap; no-ops when
+    tracing is off or no parent is supplied."""
+    if not TRACES.enabled or parent is None:
+        yield None
+        return
+    sp = Span(parent.trace_id, new_span_id(), parent.span_id, name,
+              attributes)
+    error: Optional[BaseException] = None
+    try:
+        yield sp
+    except BaseException as e:
+        error = e
+        raise
+    finally:
+        sp.end = _now()
+        if error is not None:
+            sp.error = True
+            sp.attributes.setdefault("exception", type(error).__name__)
+        TRACES.add_span(sp)
+
+
+class StageTimeline:
+    """Thread-safe wall-span collector for pipeline overlap accounting.
+
+    Each :meth:`scope` (or :meth:`wrap_iter` step) appends one
+    ``(stage, start, end, thread)`` record in epoch seconds, from
+    WHICHEVER thread ran it — producer decode spans interleave with
+    consumer index/bucket spans. :meth:`summary` reduces them to
+    per-stage busy totals, the union wall span, and the overlap ratio
+    (busy/wall; 1.0 = fully serial, higher = real overlap);
+    :meth:`to_json` is the bench's per-stage timeline artifact, and the
+    same scopes mirror into the trace buffer (via :func:`detached_span`
+    when a parent context is given) so Perfetto shows the identical
+    picture."""
+
+    def __init__(self):
+        self._spans: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    def add(self, stage: str, start: float, end: float) -> None:
+        with self._lock:
+            self._spans.append({
+                "stage": stage, "start": start, "end": end,
+                "durationSec": round(end - start, 6),
+                "thread": threading.get_ident(),
+            })
+
+    @contextlib.contextmanager
+    def scope(self, stage: str,
+              trace_parent: Optional[SpanContext] = None):
+        # _now(): monotonic-derived epoch (same clock as every Span) —
+        # a wall-clock step mid-ingest must not corrupt durations
+        with detached_span(f"ingest.{stage}", trace_parent):
+            t0 = _now()
+            try:
+                yield
+            finally:
+                self.add(stage, t0, _now())
+
+    def wrap_iter(self, it, stage: str,
+                  trace_parent: Optional[SpanContext] = None):
+        """Yield from ``it`` timing each ``next()`` as one stage span —
+        run inside a producer thread this measures exactly the decode
+        wall time, on the decode thread."""
+        it = iter(it)
+        while True:
+            with self.scope(stage, trace_parent):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+            yield item
+
+    def spans(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._spans)
+
+    def summary(self, spans: Optional[List[Dict[str, Any]]] = None
+                ) -> Dict[str, Any]:
+        if spans is None:
+            spans = self.spans()
+        if not spans:
+            return {"stages": {}, "wall_sec": 0.0, "busy_sec": 0.0,
+                    "overlap_ratio": None}
+        stages: Dict[str, Dict[str, Any]] = {}
+        for s in spans:
+            st = stages.setdefault(s["stage"],
+                                   {"busy_sec": 0.0, "spans": 0,
+                                    "first_start": s["start"],
+                                    "last_end": s["end"]})
+            st["busy_sec"] += s["end"] - s["start"]
+            st["spans"] += 1
+            st["first_start"] = min(st["first_start"], s["start"])
+            st["last_end"] = max(st["last_end"], s["end"])
+        wall = (max(s["end"] for s in spans)
+                - min(s["start"] for s in spans))
+        busy = sum(s["end"] - s["start"] for s in spans)
+        for st in stages.values():
+            st["busy_sec"] = round(st["busy_sec"], 4)
+            st["wall_span_sec"] = round(st.pop("last_end")
+                                        - st.pop("first_start"), 4)
+        return {
+            "stages": stages,
+            "wall_sec": round(wall, 4),
+            "busy_sec": round(busy, 4),
+            "overlap_ratio": round(busy / wall, 3) if wall > 0 else None,
+        }
+
+    def to_json(self) -> Dict[str, Any]:
+        # ONE snapshot for origin, span list, and summary — a stage
+        # still recording on another thread (e.g. the warm-up compile)
+        # must not land between them and tear the artifact
+        spans = self.spans()
+        base = min((s["start"] for s in spans), default=0.0)
+        return {
+            "origin_epoch_sec": base,
+            "spans": [{**s, "start": round(s["start"] - base, 6),
+                       "end": round(s["end"] - base, 6)}
+                      for s in spans],
+            "summary": self.summary(spans),
+        }
+
+
 # ---------------------------------------------------------------------------
 # Exporters
 # ---------------------------------------------------------------------------

@@ -97,6 +97,19 @@ def test_two_process_training_matches_single(tmp_path):
     outs = []
     for p in procs:
         out, err = p.communicate(timeout=240)
+        if p.returncode != 0 and \
+                "aren't implemented on the CPU backend" in err:
+            # env artifact (triaged PR 6): this jaxlib's CPU client has
+            # no multi-process collectives — the workers initialize and
+            # build the 2-host mesh, but the first sharded dispatch
+            # raises INVALID_ARGUMENT. Real multi-host runs (TPU) are
+            # unaffected; nothing to fix on our side.
+            for q in procs:
+                q.kill()
+            pytest.skip("jaxlib CPU backend lacks multi-process "
+                        "collectives (XlaRuntimeError: Multiprocess "
+                        "computations aren't implemented on the CPU "
+                        "backend)")
         assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
         outs.append(json.loads(out.strip().splitlines()[-1]))
 
