@@ -23,7 +23,9 @@ thread-safe, so threads are the idiomatic host-side concurrency here
 from __future__ import annotations
 
 import base64
+import collections
 import dataclasses
+import hashlib
 import json
 import logging
 import threading
@@ -106,6 +108,15 @@ class EventServer:
         # per-instance so one exhausted server cannot poison another
         self._event_label = metrics.BoundedLabel(cap=100)
         self.plugin_context = plugin_context or EventServerPluginContext()
+        # (app, channel, body-digest) -> acked count of recently
+        # fully-committed /storage appends. The wire retries a
+        # byte-identical body, so a retried POST that hits here is a
+        # pure replay of a committed append — answered in O(hash),
+        # never rescanning the store. A miss (server restart, partial
+        # commit) falls back to the exact existence scan.
+        self._append_seen: "collections.OrderedDict[tuple, int]" = \
+            collections.OrderedDict()
+        self._append_seen_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -414,17 +425,92 @@ class EventServer:
         app_id, ch = self._storage_scope(query)
         return 200, {"ok": bool(self.event_client.remove(app_id, ch))}
 
-    def storage_append(self, query, body: bytes) -> Tuple[int, Any]:
+    _APPEND_SEEN_CAP = 512
+
+    def storage_append(self, query, body: bytes,
+                       retried: bool = False) -> Tuple[int, Any]:
         app_id, ch = self._storage_scope(query)
+        digest = (app_id, ch, hashlib.sha256(body).digest())
+        if retried:
+            acked = self._recent_append_count(digest)
+            if acked is not None:
+                logger.info("storage append retry: byte-identical replay"
+                            " of a committed append; skipped")
+                return 200, {"count": acked}
         lines = [ln for ln in body.decode("utf-8").split("\n")
                  if ln.strip()]
+        # the ack (and the replay-cache entry) count the LOGICAL lines
+        # of this request: after the dedup scan drops already-committed
+        # lines, the whole body is durable — acking the post-dedup
+        # remainder would make the same retried request answer 10 on a
+        # cache hit but 0 after a server restart
+        n_acked = len(lines)
         le = self.event_client
+        if retried and lines:
+            lines = self._dedup_retried_lines(lines, app_id, ch)
         if hasattr(le, "append_raw_lines"):
             le.append_raw_lines(lines, app_id, ch)
         else:
             le.insert_batch([Event.from_json(ln) for ln in lines],
                             app_id, ch)
-        return 200, {"count": len(lines)}
+        self._remember_append(digest, n_acked)
+        return 200, {"count": n_acked}
+
+    def _recent_append_count(self, digest: tuple) -> Optional[int]:
+        with self._append_seen_lock:
+            acked = self._append_seen.get(digest)
+            if acked is not None:
+                self._append_seen.move_to_end(digest)
+            return acked
+
+    def _remember_append(self, digest: tuple, count: int) -> None:
+        with self._append_seen_lock:
+            self._append_seen[digest] = count
+            self._append_seen.move_to_end(digest)
+            while len(self._append_seen) > self._APPEND_SEEN_CAP:
+                self._append_seen.popitem(last=False)
+
+    def _dedup_retried_lines(self, lines, app_id: int,
+                             ch: Optional[int]):
+        """Exactly-once for RETRIED appends (``X-Idempotency-Retry``):
+        the client's first attempt may have committed before its
+        response was lost — a blind re-append would duplicate every
+        acknowledged-but-unacked event. Backends whose insert is an
+        id-keyed upsert (sqlite, memory) dedup natively; append-only
+        backends (jsonlfs) get one existence scan here. The scan runs
+        ONLY on retried requests that missed the byte-identical replay
+        cache (server restarted, or the first attempt only partially
+        committed), so the bulk-ingest hot path pays nothing and the
+        common retry pays a hash, not a store scan."""
+        from predictionio_tpu.data.storage.observed import unwrap
+
+        le = self.event_client
+        if getattr(unwrap(le), "idempotent_event_writes", False):
+            return lines
+        existing = {e.event_id
+                    for e in le.find(app_id=app_id, channel_id=ch)}
+        kept = []
+        for ln in lines:
+            try:
+                eid = json.loads(ln).get("eventId")
+            except (json.JSONDecodeError, AttributeError):
+                eid = None
+            if eid and eid in existing:
+                continue
+            kept.append(ln)
+        if len(kept) != len(lines):
+            logger.info("storage append retry: deduplicated %d of %d "
+                        "already-committed events",
+                        len(lines) - len(kept), len(lines))
+        return kept
+
+    def health_checks(self) -> Dict[str, bool]:
+        """Readiness checks for ``GET /healthz``: the event store's
+        circuit breaker must not be refusing calls (liveness is the
+        response itself)."""
+        from predictionio_tpu.utils import resilience
+
+        return {"storage": resilience.storage_ready(self.event_client)}
 
     def storage_get_event(self, query, event_id: str) -> Tuple[int, Any]:
         app_id, ch = self._storage_scope(query)
@@ -629,7 +715,8 @@ class _EventHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
     # route patterns for metric labels: bounded cardinality, never raw
     # paths (an id or webhook name must not mint a new series)
     def _route_label(self, path: str) -> str:
-        if path in ("/", "/metrics", "/stats.json", "/events.json",
+        if path in ("/", "/healthz", "/metrics", "/stats.json",
+                    "/events.json",
                     "/batch/events.json", "/plugins.json", "/traces.json",
                     "/storage/events.jsonl", "/storage/init.json",
                     "/storage/remove.json", "/storage/delete_until.json",
@@ -668,6 +755,11 @@ class _EventHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         try:
             if path == "/" and method == "GET":
                 self._respond(200, {"status": "alive"})
+                return
+            if path == "/healthz" and method == "GET":
+                # liveness + readiness probe: unauthenticated like
+                # GET / (a load balancer has no access key)
+                self._respond_healthz(srv.health_checks())
                 return
             if path == "/metrics" and method == "GET":
                 # Prometheus scrape endpoint: unauthenticated like GET /.
@@ -761,7 +853,9 @@ class _EventHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
                 self._respond_chunked(200, srv.storage_stream(query))
                 return
             if method == "POST":
-                self._respond(*srv.storage_append(query, self._body()))
+                retried = bool(self.headers.get("X-Idempotency-Retry"))
+                self._respond(*srv.storage_append(query, self._body(),
+                                                  retried=retried))
                 return
         elif path == "/storage/init.json" and method == "POST":
             self._respond(*srv.storage_init(query))

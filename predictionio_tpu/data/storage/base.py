@@ -27,7 +27,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 from predictionio_tpu.data.aggregator import aggregate_properties
 from predictionio_tpu.data.datamap import PropertyMap
 from predictionio_tpu.data.event import Event
-from predictionio_tpu.utils import metrics
+from predictionio_tpu.utils import metrics, resilience
 
 # Sentinel distinguishing "no filter" from "filter for None"
 # (reference models this as Option[Option[String]], LEvents.scala:137-150).
@@ -36,6 +36,51 @@ UNSET = object()
 
 class StorageError(RuntimeError):
     pass
+
+
+class StorageCircuitOpen(StorageError,
+                         resilience.CircuitOpenError):
+    """The storage endpoint's circuit breaker refused the call —
+    BOTH a ``StorageError`` (callers treating "storage is down"
+    uniformly, e.g. ``pio status``, catch it) and a
+    ``CircuitOpenError`` (resilience-aware callers read the breaker
+    semantics and the ``pio_retry_after`` hint)."""
+
+    def __init__(self, endpoint: str, retry_in: float):
+        resilience.CircuitOpenError.__init__(self, endpoint, retry_in)
+
+    @classmethod
+    def from_error(cls, e: "resilience.CircuitOpenError"
+                   ) -> "StorageCircuitOpen":
+        return cls(e.endpoint, getattr(e, "pio_retry_after", 0.0))
+
+
+def run_guarded(breaker: "resilience.CircuitBreaker",
+                policy: "resilience.RetryPolicy",
+                attempt_fn, *, idempotent: Any = True,
+                on_retry=None, defer_success: bool = False):
+    """The breaker + retry shell shared by the DAO wrapper
+    (``observed.DAOMetricsWrapper``) and the resthttp ``_Wire``: gate
+    on the breaker (an open circuit surfaces as
+    :class:`StorageCircuitOpen` so "storage is down" handlers catch
+    it), run ``attempt_fn`` under the retry policy, feed the final
+    outcome back to the breaker. ``defer_success`` skips the success
+    mark — for lazy ops (``find`` returning a generator) the CALLER
+    records the outcome when iteration ends, so generator creation
+    cannot masquerade as a healthy read."""
+    try:
+        breaker.before_call()
+    except resilience.CircuitOpenError as e:
+        raise StorageCircuitOpen.from_error(e) from None
+    try:
+        result = policy.run(attempt_fn, idempotent=idempotent,
+                            on_retry=on_retry)
+    except BaseException as e:
+        breaker.record_failure(e)
+        raise
+    if not defer_success:
+        breaker.record_success()
+    return result
 
 
 class LEvents(abc.ABC):

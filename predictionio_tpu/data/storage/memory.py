@@ -59,6 +59,9 @@ def match_event(
 
 class MemLEvents(base.LEvents):
     metrics_backend = "memory"
+    # insert is an upsert keyed by event id: a retried insert with the
+    # same (pre-assigned) ids replays to the identical state
+    idempotent_event_writes = True
 
     def __init__(self, config: Optional[dict] = None):
         # (app_id, channel_id) -> {event_id: Event}; insertion order kept

@@ -1,4 +1,5 @@
-"""DAO instrumentation: per-backend / per-op latency + error counters.
+"""DAO instrumentation + resilience: latency/error metrics, retries,
+circuit breaking, and deterministic fault injection.
 
 The storage registry wraps every event-store ``LEvents`` DAO it hands
 out in :class:`DAOMetricsWrapper`, so all four event backends (memory,
@@ -8,6 +9,18 @@ in the backends themselves. Slow-path attribution rides the
 request-scoped tracing contextvar: with debug logging on, every storage
 op logs a record tagged with the ``X-Request-ID`` of the HTTP request
 that caused it.
+
+The wrapper is also the resilience chokepoint for LOCAL backends: each
+op runs under the shared :class:`~predictionio_tpu.utils.resilience.
+RetryPolicy` behind the backend's per-endpoint circuit breaker, with
+the ``PIO_FAULTS`` injection hook (:mod:`predictionio_tpu.utils.faults`)
+consulted immediately before the real call — so injected transients sit
+INSIDE the retry loop and are masked exactly like real ones. Insert ops
+pre-assign client-generated event ids before the first attempt, making
+retried inserts idempotent on backends that dedup by event id
+(``idempotent_event_writes``); backends that own their resilience
+(resthttp: retries live in the wire, under the wire's breaker) declare
+``self_resilient`` and are passed through untouched.
 
 The wrapper is transparent: unknown attributes delegate to the wrapped
 DAO (the jsonlfs raw-partition fast lane reads ``_dir``/``_parts``
@@ -24,10 +37,14 @@ import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from predictionio_tpu.data.storage import base
-from predictionio_tpu.utils import metrics, tracing
+from predictionio_tpu.utils import faults, metrics, resilience, tracing
 from predictionio_tpu.utils.tracing import current_request_id
 
 logger = logging.getLogger("pio.storage.ops")
+
+# ops that mutate the store by APPENDING events — retried only when the
+# events carry idempotency keys (event ids) a backend can dedup on
+_WRITE_OPS = frozenset({"insert", "insert_batch", "append_raw_lines"})
 
 # passthrough attributes that still deserve timing (optional per backend)
 _EXTRA_TIMED_OPS = ("append_raw_lines",)
@@ -41,13 +58,17 @@ def unwrap(dao: Any) -> Any:
 class _TimedIterator:
     """Wraps a lazy ``find`` result so the recorded duration covers the
     scan, not just generator creation; abandoning the iterator records
-    nothing (there is no completed op to account)."""
+    nothing (there is no completed op to account). ``fail`` (optional)
+    accounts a mid-iteration error — the scan IS the op, so a backend
+    dying partway through must register as the op failing."""
 
-    __slots__ = ("_it", "_done")
+    __slots__ = ("_it", "_done", "_fail")
 
-    def __init__(self, it: Iterator, done: Callable[[], None]):
+    def __init__(self, it: Iterator, done: Callable[[], None],
+                 fail: Optional[Callable[[BaseException], None]] = None):
         self._it = iter(it)
         self._done = done
+        self._fail = fail
 
     def __iter__(self) -> "_TimedIterator":
         return self
@@ -59,6 +80,12 @@ class _TimedIterator:
             done, self._done = self._done, lambda: None
             done()
             raise
+        except BaseException as e:
+            fail, self._fail = self._fail, None
+            self._done = lambda: None
+            if fail is not None:
+                fail(e)
+            raise
 
 
 class DAOMetricsWrapper(base.LEvents):
@@ -69,9 +96,80 @@ class DAOMetricsWrapper(base.LEvents):
         self._wrapped = wrapped
         self.metrics_backend = backend or getattr(
             wrapped, "metrics_backend", type(wrapped).__name__)
+        # resilience surface: the endpoint names the availability
+        # domain (a wire URL for resthttp, the backend name locally)
+        self.resilience_endpoint = getattr(
+            wrapped, "resilience_endpoint", None) or self.metrics_backend
+        self._self_resilient = bool(
+            getattr(wrapped, "self_resilient", False))
+        self._idempotent_writes = bool(
+            getattr(wrapped, "idempotent_event_writes", False))
+        self._breaker = resilience.breaker_for(self.resilience_endpoint)
+        self._policy = resilience.RetryPolicy.from_env()
 
     def unwrap(self) -> base.LEvents:
         return self._wrapped
+
+    # -- resilience -------------------------------------------------------
+    def _attempt(self, op: str, fn: Callable, args: tuple, kwargs: dict):
+        """One attempt: consult the fault injector, honor a torn-write
+        directive (execute HALF the write, then fail ambiguously —
+        the mid-write-crash shape), then run the real op."""
+        directive = faults.maybe_fault(self.metrics_backend, op)
+        if directive is not None:
+            if op in ("insert_batch", "append_raw_lines") and args:
+                seq = list(args[0])
+                half = seq[:len(seq) // 2]
+                if half:
+                    fn(half, *args[1:], **kwargs)
+            raise directive.error()
+        return fn(*args, **kwargs)
+
+    def _call_resilient(self, op: str, fn: Callable,
+                        args: tuple, kwargs: dict,
+                        defer_success: bool = False):
+        """Breaker + retry + fault hook around one DAO op. Insert ops
+        get their event ids assigned BEFORE the first attempt so every
+        retry replays the same ids (the idempotency keys backends
+        dedup on). ``defer_success`` skips the breaker's success mark —
+        for lazy ops (``find`` returns a generator whose scan has not
+        run yet) the CALLER records the outcome when iteration ends, so
+        generator creation cannot masquerade as a healthy read and keep
+        resetting the breaker's consecutive-failure count."""
+        if self._self_resilient:
+            return fn(*args, **kwargs)
+        if not resilience.enabled():
+            # kill switch drops retries + breaker, NOT fault injection
+            # (the chaos bench measures the unmasked error rate here)
+            return self._attempt(op, fn, args, kwargs)
+        idempotent = op not in _WRITE_OPS or self._idempotent_writes
+        if op in ("insert", "insert_batch") and args:
+            from predictionio_tpu.data.event import new_event_id
+
+            if op == "insert":
+                ev = args[0]
+                if hasattr(ev, "with_id") and \
+                        not getattr(ev, "event_id", None):
+                    args = (ev.with_id(new_event_id()),) + args[1:]
+            else:
+                seq = list(args[0])
+                if all(hasattr(e, "with_id") for e in seq):
+                    seq = [e if getattr(e, "event_id", None)
+                           else e.with_id(new_event_id()) for e in seq]
+                args = (seq,) + args[1:]
+        def on_retry(attempt: int, exc: BaseException,
+                     delay: float) -> None:
+            metrics.STORAGE_RETRIES.inc(backend=self.metrics_backend,
+                                        op=op)
+            logger.debug("storage %s.%s retry %d in %.3fs after %r",
+                         self.metrics_backend, op, attempt + 1, delay,
+                         exc)
+
+        return base.run_guarded(
+            self._breaker, self._policy,
+            lambda attempt: self._attempt(op, fn, args, kwargs),
+            idempotent=idempotent, on_retry=on_retry,
+            defer_success=defer_success)
 
     # -- accounting -------------------------------------------------------
     def _record(self, op: str, t0: float,
@@ -96,10 +194,10 @@ class DAOMetricsWrapper(base.LEvents):
             f"storage.{self.metrics_backend}.{op}")
         record = metrics.REGISTRY.enabled
         if not record and sp is None:
-            return fn(*args, **kwargs)
+            return self._call_resilient(op, fn, args, kwargs)
         t0 = time.perf_counter()
         try:
-            result = fn(*args, **kwargs)
+            result = self._call_resilient(op, fn, args, kwargs)
         except BaseException as e:
             if record:
                 self._record(op, t0, error=e)
@@ -148,11 +246,20 @@ class DAOMetricsWrapper(base.LEvents):
         sp, _ = tracing.begin_span(
             f"storage.{self.metrics_backend}.find", set_current=False)
         record = metrics.REGISTRY.enabled
-        if not record and sp is None:
-            return self._wrapped.find(app_id, channel_id, **kwargs)
+        # the retry covers find() CREATION (local backends with lazy
+        # scans return a generator from it); consuming the returned
+        # iterator is not replayable — a mid-iteration failure
+        # propagates — but it IS the scan, so the breaker's verdict
+        # (success or failure) is deferred to the iterator's end
+        deferred = not self._self_resilient and resilience.enabled()
+        if not record and sp is None and not deferred:
+            return self._call_resilient(
+                "find", self._wrapped.find, (app_id, channel_id), kwargs)
         t0 = time.perf_counter()
         try:
-            it = self._wrapped.find(app_id, channel_id, **kwargs)
+            it = self._call_resilient(
+                "find", self._wrapped.find, (app_id, channel_id), kwargs,
+                defer_success=deferred)
         except BaseException as e:
             if record:
                 self._record("find", t0, error=e)
@@ -160,10 +267,19 @@ class DAOMetricsWrapper(base.LEvents):
             raise
 
         def done() -> None:
+            if deferred:
+                self._breaker.record_success()
             if record:
                 self._record("find", t0)
             tracing.finish_span(sp)
-        return _TimedIterator(it, done)
+
+        def fail(e: BaseException) -> None:
+            if deferred:
+                self._breaker.record_failure(e)
+            if record:
+                self._record("find", t0, error=e)
+            tracing.finish_span(sp, error=e)
+        return _TimedIterator(it, done, fail)
 
     def materialized_aggregate(self, app_id, entity_type, channel_id=None):
         return self._observe(

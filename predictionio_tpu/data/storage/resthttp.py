@@ -32,7 +32,9 @@ raises per-kind capability errors otherwise).
 from __future__ import annotations
 
 import json
-import urllib.error
+import logging
+import os
+import socket
 import urllib.parse
 import urllib.request
 from typing import Iterable, List, Optional, Sequence
@@ -45,11 +47,67 @@ from predictionio_tpu.data.event import (
 )
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import UNSET, StorageError
+from predictionio_tpu.utils import faults, metrics, resilience
 from predictionio_tpu.utils.tracing import outbound_context_headers, span
+
+logger = logging.getLogger("pio.storage.resthttp")
+
+
+class StorageUnavailable(StorageError):
+    """The event server could not be reached. When the failure happened
+    at CONNECT time the request provably never executed (retry class
+    SAFE — any op, idempotent or not, may retry); after the request was
+    sent the class is AMBIGUOUS."""
+
+    def __init__(self, msg: str, retry_class: str = resilience.SAFE):
+        super().__init__(msg)
+        self.pio_retry_class = retry_class
+
+
+class StorageTimeout(StorageError, TimeoutError):
+    """A wire read exceeded the read timeout (the op may have run)."""
+
+    pio_retry_class = resilience.AMBIGUOUS
+
+
+class StorageServerError(StorageError):
+    """HTTP 5xx (or 429) from the event server; carries the parsed
+    ``Retry-After`` so backoff honors the server's own pacing."""
+
+    pio_retry_class = resilience.AMBIGUOUS
+
+    def __init__(self, msg: str, status: int,
+                 retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.status = int(status)
+        if retry_after is not None:
+            self.pio_retry_after = retry_after
+
+
+def _parse_retry_after(value: Optional[str]) -> Optional[float]:
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        return None  # HTTP-date form: not worth a date parse here
 
 
 class _Wire:
-    """Shared HTTP plumbing for the storage wire.
+    """Shared HTTP plumbing for the storage wire, resilience included.
+
+    Timeouts are SPLIT: ``connect_timeout`` (config ``connect_timeout``
+    / ``$PIO_STORAGE_CONNECT_TIMEOUT``, default 3s — a dead host must
+    fail in seconds, not a minute) bounds the TCP/TLS dial;
+    ``read_timeout`` (config ``read_timeout`` / legacy ``timeout`` /
+    ``$PIO_STORAGE_READ_TIMEOUT``, default 60s) bounds each blocking
+    read of the open socket. Every call runs under the shared
+    :class:`~predictionio_tpu.utils.resilience.RetryPolicy` behind this
+    URL's circuit breaker: connect-phase failures retry anything, 5xx /
+    timeouts retry idempotent calls (event inserts ARE idempotent —
+    the client assigns event ids before the first attempt and flags
+    retries with ``X-Idempotency-Retry`` so the server dedups), and
+    ``Retry-After`` floors the backoff.
 
     For an ``https://`` URL, ``ca_file`` pins the server certificate
     (the usual self-signed deployment); ``insecure_skip_verify`` (bool)
@@ -58,10 +116,30 @@ class _Wire:
     def __init__(self, config: Optional[dict] = None):
         cfg = config or {}
         self.url = (cfg.get("url") or "http://127.0.0.1:7070").rstrip("/")
+        parts = urllib.parse.urlsplit(self.url)
+        self._scheme = parts.scheme or "http"
+        self._host = parts.hostname or "127.0.0.1"
+        self._port = parts.port or (443 if self._scheme == "https" else 80)
+        # an event server behind a reverse-proxy path prefix
+        # (http://gw/pio-events) keeps its prefix on every wire path
+        self._base_path = parts.path.rstrip("/")
         self.service_key = cfg.get("service_key") or ""
-        self.timeout = float(cfg.get("timeout", 60))
+        legacy = cfg.get("timeout")
+        self.connect_timeout = float(
+            cfg.get("connect_timeout")
+            or os.environ.get("PIO_STORAGE_CONNECT_TIMEOUT") or 3.0)
+        self.read_timeout = float(
+            cfg.get("read_timeout") or legacy
+            or os.environ.get("PIO_STORAGE_READ_TIMEOUT") or 60.0)
+        # the default op budget must survive one full read stall plus a
+        # retry, or timeout-class failures can never actually retry
+        # (PIO_STORAGE_OP_DEADLINE, when set, overrides this)
+        self.policy = resilience.RetryPolicy.from_env(
+            default_deadline=max(30.0, 2.0 * self.read_timeout
+                                 + 2.0 * self.connect_timeout))
+        self.breaker = resilience.breaker_for(self.url)
         self._ssl_ctx = None
-        if self.url.startswith("https://"):
+        if self._scheme == "https":
             import ssl
 
             ca = cfg.get("ca_file")
@@ -79,89 +157,287 @@ class _Wire:
                 ctx.check_hostname = False
                 ctx.verify_mode = ssl.CERT_NONE
             self._ssl_ctx = ctx
-
-    def _open(self, req):
-        return urllib.request.urlopen(req, timeout=self.timeout,
-                                      context=self._ssl_ctx)
+        # the wire dials the event server DIRECTLY: it is an internal
+        # service hop, and routing storage traffic through an ambient
+        # egress proxy (which the pre-split-timeout urllib lane did by
+        # accident) is the classic way internal traffic breaks. Say so
+        # loudly instead of failing with an opaque connect error.
+        proxies = urllib.request.getproxies()
+        if proxies.get(self._scheme) and \
+                not urllib.request.proxy_bypass(self._host):
+            logger.warning(
+                "%s_proxy is set but the storage wire connects to %s "
+                "directly (proxies are not supported on this hop); "
+                "add the host to no_proxy to silence this",
+                self._scheme, self.url)
 
     def _full(self, path: str, params: dict) -> str:
+        """Path + query (http.client takes the host separately)."""
         q = {"serviceKey": self.service_key}
         for k, v in params.items():
             if v is not None:
                 q[k] = v
-        return f"{self.url}{path}?" + urllib.parse.urlencode(q, doseq=True)
+        return f"{self._base_path}{path}?" + \
+            urllib.parse.urlencode(q, doseq=True)
 
-    @staticmethod
-    def _inject_context(req) -> None:
-        """Forward the caller's observability context on EVERY wire
-        call: the contextvar request id (so the server's storage-op
-        records join the originating request) and the W3C traceparent
-        (so the server's spans join the originating trace). Must run
-        INSIDE the wire span, which is then the remote spans' parent."""
-        for name, value in outbound_context_headers().items():
-            req.add_header(name, value)
+    def _headers(self, body: Optional[bytes], attempt: int,
+                 replay_possible: bool = False) -> dict:
+        """Observability context on EVERY wire call (request id +
+        traceparent, so the server's spans join the caller's trace).
+        ``X-Idempotency-Retry`` goes out only when a PRIOR attempt of
+        this op failed AMBIGUOUSLY — i.e. the server may have committed
+        it. A SAFE failure (connect refused: the request provably never
+        left) must NOT flag the retry: the server's byte-digest replay
+        cache would otherwise swallow a legitimate id-less append whose
+        bytes happen to match an earlier committed one."""
+        headers = dict(outbound_context_headers())
+        if body is not None:
+            headers["Content-Type"] = "application/x-jsonlines"
+        if attempt > 0 and replay_possible:
+            headers["X-Idempotency-Retry"] = str(attempt)
+        return headers
+
+    def _request_once(self, method: str, pathq: str,
+                      body: Optional[bytes], headers: dict):
+        """One HTTP exchange under the split timeouts. Returns
+        ``(conn, resp)`` — the caller reads and closes. Connect-phase
+        failures are SAFE (nothing was sent); post-send failures are
+        AMBIGUOUS."""
+        import http.client
+
+        try:
+            if self._scheme == "https":
+                conn = http.client.HTTPSConnection(
+                    self._host, self._port, timeout=self.connect_timeout,
+                    context=self._ssl_ctx)
+            else:
+                conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=self.connect_timeout)
+            conn.connect()
+        except (TimeoutError, socket.timeout) as e:
+            raise StorageUnavailable(
+                f"event server unreachable at {self.url}: connect timed "
+                f"out after {self.connect_timeout}s",
+                retry_class=resilience.SAFE) from e
+        except OSError as e:
+            # refused / DNS / TLS dial failure: the request never left
+            raise StorageUnavailable(
+                f"event server unreachable at {self.url}: {e}",
+                retry_class=resilience.SAFE) from e
+        try:
+            # the dial is done: from here each blocking socket op runs
+            # under the (longer) read deadline
+            conn.sock.settimeout(self.read_timeout)
+            conn.request(method, pathq, body=body, headers=headers)
+            resp = conn.getresponse()
+            return conn, resp
+        except (TimeoutError, socket.timeout) as e:
+            conn.close()
+            raise StorageTimeout(
+                f"{method} {self.url}: no response within "
+                f"{self.read_timeout}s") from e
+        except (OSError, http.client.HTTPException) as e:
+            # BadStatusLine & co are HTTPException, NOT OSError — a
+            # server killed mid-response must still classify AMBIGUOUS
+            # (it may have committed) and must not leak the connection
+            conn.close()
+            raise StorageUnavailable(
+                f"event server dropped the connection at {self.url}: {e}",
+                retry_class=resilience.AMBIGUOUS) from e
+
+    _MAX_REDIRECTS = 3
+
+    def _request_redirects(self, method: str, pathq: str,
+                           body: Optional[bytes], headers: dict):
+        """``_request_once`` plus bounded SAME-ORIGIN redirect following
+        for GETs — the old urllib lane followed read redirects (e.g. a
+        gateway's trailing-slash canonicalization) and the http.client
+        rewrite must not regress that. A cross-origin ``Location``
+        (scheme/host/port change, e.g. an http->https upgrade) is a
+        config error surfaced loudly: silently re-dialing a different
+        origin would hide the misconfigured storage URL. Writes are
+        never redirected (urllib's POST handling re-issued as GET —
+        never correct on this wire)."""
+        for _ in range(self._MAX_REDIRECTS):
+            conn, resp = self._request_once(method, pathq, body, headers)
+            if method != "GET" or resp.status not in (301, 302, 303,
+                                                      307, 308):
+                return conn, resp
+            loc = resp.headers.get("Location")
+            try:
+                resp.read()
+            finally:
+                conn.close()
+            if not loc:
+                raise StorageError(
+                    f"{method} {pathq}: {resp.status} redirect with no "
+                    "Location header")
+            parts = urllib.parse.urlsplit(loc)
+            if parts.scheme or parts.netloc:
+                port = parts.port or (
+                    443 if (parts.scheme or self._scheme) == "https"
+                    else 80)
+                if (parts.scheme or self._scheme) != self._scheme or \
+                        parts.hostname != self._host or \
+                        port != self._port:
+                    raise StorageError(
+                        f"{method} {pathq}: redirected off-origin to "
+                        f"{loc}; update the storage URL ({self.url}) to "
+                        "the canonical endpoint")
+                pathq = parts.path + (f"?{parts.query}"
+                                      if parts.query else "")
+            else:
+                pathq = loc
+        raise StorageError(
+            f"{method}: more than {self._MAX_REDIRECTS} redirects from "
+            f"{self.url}")
+
+    def _check_status(self, status: int, raw: bytes, context: str,
+                      retry_after_hdr: Optional[str], ok) -> None:
+        """ONE definition of which wire statuses are retryable: 5xx and
+        429 raise StorageServerError (Retry-After parsed), other
+        not-ok statuses are permanent StorageErrors."""
+        if status in ok:
+            return
+        try:
+            payload = json.loads(raw.decode("utf-8"))
+            msg = payload.get("message", payload)
+        except Exception:
+            msg = raw.decode("utf-8", "replace")
+        if status >= 500 or status == 429:
+            raise StorageServerError(
+                f"{context} -> {status}: {msg}", status,
+                _parse_retry_after(retry_after_hdr))
+        raise StorageError(f"{context} -> {status}: {msg}")
+
+    def _run_resilient(self, attempt_fn, op: str,
+                       idempotent=True, retry_state: Optional[dict] = None):
+        """Breaker + retry shell shared by ``call`` and ``stream``.
+        ``retry_state`` (when given) gets ``ambiguous=True`` once any
+        failed attempt may have executed server-side — the attempt fn
+        reads it to decide whether the next request flags itself as a
+        possible replay."""
+        if not resilience.enabled():
+            return attempt_fn(0)
+
+        def on_retry(attempt: int, exc: BaseException,
+                     delay: float) -> None:
+            metrics.STORAGE_RETRIES.inc(backend="resthttp", op=op)
+            if retry_state is not None and \
+                    resilience.classify(exc) == resilience.AMBIGUOUS:
+                retry_state["ambiguous"] = True
+
+        return base.run_guarded(self.breaker, self.policy, attempt_fn,
+                                idempotent=idempotent, on_retry=on_retry)
 
     def call(self, method: str, path: str, params: dict,
-             body: Optional[bytes] = None, ok=(200,)):
+             body: Optional[bytes] = None, ok=(200,),
+             op: Optional[str] = None, idempotent=True):
+        """One JSON wire call with retries. ``op`` names the logical
+        DAO op for fault-injection matching and retry metrics. Wire
+        calls default idempotent (reads, idempotent admin verbs, and
+        id-carrying event appends the server dedups); a caller sending
+        id-LESS event lines must pass ``idempotent=False`` — the
+        server cannot dedup what carries no key."""
+        opname = op or f"{method} {path}"
+        pathq = self._full(path, params)
+        retry_state = {"ambiguous": False}
         with span(f"resthttp {method} {path}",
                   attributes={"url": self.url}):
-            req = urllib.request.Request(self._full(path, params),
-                                         data=body, method=method)
-            if body is not None:
-                req.add_header("Content-Type", "application/x-jsonlines")
-            self._inject_context(req)
-            try:
-                with self._open(req) as resp:
-                    payload = json.loads(resp.read().decode("utf-8"))
-                    status = resp.status
-            except urllib.error.HTTPError as e:
-                status = e.code
-                try:
-                    payload = json.loads(e.read().decode("utf-8"))
-                except Exception:
-                    payload = {"message": str(e)}
-            except OSError as e:  # URLError is an OSError subclass
-                # also covers connection-level failures urlopen does not
-                # wrap (e.g. RemoteDisconnected from plain HTTP hitting a
-                # TLS listener)
-                raise StorageError(
-                    f"event server unreachable at {self.url}: {e}") from e
-            if status not in ok:
-                raise StorageError(
-                    f"{method} {path} -> {status}: "
-                    f"{payload.get('message', payload)}")
-            return status, payload
+            def attempt(n: int):
+                # injected faults sit INSIDE the retry loop, like real
+                # ones; a torn directive means "the server committed
+                # but the response was lost" — execute fully, discard
+                # the response, fail ambiguously (the retry + the
+                # server-side dedup then prove exactly-once)
+                import http.client
 
-    def stream(self, params: dict):
+                torn = faults.maybe_fault("resthttp", opname)
+                conn, resp = self._request_redirects(
+                    method, pathq, body,
+                    self._headers(body, n,
+                                  replay_possible=retry_state["ambiguous"]))
+                try:
+                    raw = resp.read()
+                    status = resp.status
+                    retry_after = resp.headers.get("Retry-After")
+                except (TimeoutError, socket.timeout) as e:
+                    raise StorageTimeout(
+                        f"{method} {path}: response stalled past "
+                        f"{self.read_timeout}s") from e
+                except (OSError, http.client.HTTPException) as e:
+                    # IncompleteRead = killed mid-response: AMBIGUOUS
+                    raise StorageUnavailable(
+                        f"{method} {path}: response truncated by "
+                        f"{self.url}: {e}",
+                        retry_class=resilience.AMBIGUOUS) from e
+                finally:
+                    conn.close()
+                if torn is not None:
+                    raise torn.error()
+                self._check_status(status, raw, f"{method} {path}",
+                                   retry_after, ok)
+                try:
+                    payload = json.loads(raw.decode("utf-8"))
+                except Exception:
+                    payload = {"message": raw.decode("utf-8", "replace")}
+                return status, payload
+
+            return self._run_resilient(attempt, opname,
+                                       idempotent=idempotent,
+                                       retry_state=retry_state)
+
+    def stream(self, params: dict, op: str = "find"):
         """GET /storage/events.jsonl as a raw byte-chunk iterator. The
-        wire span covers the connect + response headers (the streamed
-        read itself is accounted by the caller's storage.find span)."""
-        try:
-            with span("resthttp GET /storage/events.jsonl",
-                      attributes={"url": self.url, "streaming": True}):
-                req = urllib.request.Request(
-                    self._full("/storage/events.jsonl", params),
-                    method="GET")
-                self._inject_context(req)
-                resp = self._open(req)
-        except urllib.error.HTTPError as e:
-            try:
-                msg = json.loads(e.read().decode("utf-8")).get("message")
-            except Exception:
-                msg = str(e)
-            raise StorageError(
-                f"GET /storage/events.jsonl -> {e.code}: {msg}") from e
-        except OSError as e:  # URLError is an OSError subclass
-            raise StorageError(
-                f"event server unreachable at {self.url}: {e}") from e
+        wire span (and the retry loop) covers the connect + response
+        headers; once bytes flow, a failure is NOT replayable here —
+        the consumer has already seen a prefix — and surfaces as a
+        StorageError."""
+        pathq = self._full("/storage/events.jsonl", params)
+        with span("resthttp GET /storage/events.jsonl",
+                  attributes={"url": self.url, "streaming": True}):
+            def attempt(n: int):
+                torn = faults.maybe_fault("resthttp", op)
+                conn, resp = self._request_redirects(
+                    "GET", pathq, None, self._headers(None, n))
+                if torn is not None:
+                    # response lost after the server answered: the
+                    # directive must MANIFEST (a silently-dropped torn
+                    # rule would burn its budget testing nothing)
+                    conn.close()
+                    raise torn.error()
+                if resp.status != 200:
+                    try:
+                        raw = resp.read()
+                    finally:
+                        conn.close()
+                    self._check_status(
+                        resp.status, raw, "GET /storage/events.jsonl",
+                        resp.headers.get("Retry-After"), ok=(200,))
+                return conn, resp
+
+            conn, resp = self._run_resilient(attempt, op)
 
         def chunks():
-            with resp:
+            import http.client
+
+            try:
                 while True:
                     c = resp.read(1 << 22)
                     if not c:
                         break
                     yield c
+            except (TimeoutError, socket.timeout) as e:
+                raise StorageTimeout(
+                    f"storage stream from {self.url} stalled past "
+                    f"{self.read_timeout}s") from e
+            except (OSError, http.client.HTTPException) as e:
+                # truncated chunked framing (server died mid-stream)
+                raise StorageError(
+                    f"storage stream from {self.url} interrupted: "
+                    f"{e}") from e
+            finally:
+                conn.close()
         return chunks()
 
 
@@ -173,22 +449,32 @@ def _scope(app_id: int, channel_id: Optional[int]) -> dict:
 
 
 class RestLEvents(base.LEvents):
-    """LEvents client over the event server's storage wire."""
+    """LEvents client over the event server's storage wire.
+
+    Resilience lives IN the wire (retries + this URL's breaker around
+    every call), so the registry's DAO wrapper must not stack a second
+    retry loop on top — ``self_resilient`` tells it so. Event writes
+    are idempotent: ids are client-generated before the first attempt
+    and the server dedups retried appends (``X-Idempotency-Retry``)."""
 
     metrics_backend = "resthttp"
+    self_resilient = True
+    idempotent_event_writes = True
 
     def __init__(self, config: Optional[dict] = None):
         self._w = _Wire(config)
+        # per-endpoint availability domain: the wire URL
+        self.resilience_endpoint = self._w.url
 
     # -- lifecycle --------------------------------------------------------
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         _, p = self._w.call("POST", "/storage/init.json",
-                            _scope(app_id, channel_id))
+                            _scope(app_id, channel_id), op="init")
         return bool(p.get("ok"))
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         _, p = self._w.call("POST", "/storage/remove.json",
-                            _scope(app_id, channel_id))
+                            _scope(app_id, channel_id), op="remove")
         return bool(p.get("ok"))
 
     def close(self) -> None:
@@ -204,20 +490,42 @@ class RestLEvents(base.LEvents):
         evs = list(events)
         for e in evs:
             validate_event(e)
+        # ids assigned ONCE, before the wire's retry loop: a retried
+        # POST replays the same ids, which the server dedups
         ids = [e.event_id or new_event_id() for e in evs]
         body = "\n".join(e.with_id(i).to_json()
                          for e, i in zip(evs, ids)).encode("utf-8")
         self._w.call("POST", "/storage/events.jsonl",
-                     _scope(app_id, channel_id), body=body)
+                     _scope(app_id, channel_id), body=body,
+                     op="insert_batch")
         return ids
 
     def append_raw_lines(self, lines: Sequence[str], app_id: int,
                          channel_id: Optional[int] = None) -> None:
         """Pre-validated fast lane (same contract as the jsonlfs one):
-        the bytes go to the server verbatim."""
+        the bytes go to the server verbatim. Ambiguous failures retry
+        only when every line carries a TOP-LEVEL ``eventId`` (the
+        idempotency key the server-side dedup needs — a nested
+        properties key must not fool the check); id-less lines still
+        retry provably-unsent failures (connection refused). The exact
+        per-line parse is LAZY: only a retry decision pays it, never
+        the bulk-ingest success path."""
+        lines = list(lines)
+
+        def keyed() -> bool:
+            for ln in lines:
+                try:
+                    d = json.loads(ln)
+                except ValueError:
+                    return False
+                if not isinstance(d, dict) or not d.get("eventId"):
+                    return False
+            return True
+
         self._w.call("POST", "/storage/events.jsonl",
                      _scope(app_id, channel_id),
-                     body="\n".join(lines).encode("utf-8"))
+                     body="\n".join(lines).encode("utf-8"),
+                     op="append_raw_lines", idempotent=keyed)
 
     # -- reads ------------------------------------------------------------
     def get(self, event_id: str, app_id: int,
@@ -225,24 +533,32 @@ class RestLEvents(base.LEvents):
         quoted = urllib.parse.quote(event_id, safe="")
         status, payload = self._w.call(
             "GET", f"/storage/events/{quoted}.json",
-            _scope(app_id, channel_id), ok=(200, 404))
+            _scope(app_id, channel_id), ok=(200, 404), op="get")
         if status == 404:
             return None
         return Event.from_dict(payload)
 
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
+        # idempotent=False: the STATE change replays fine, but the
+        # RESPONSE doesn't — a retry after a committed first attempt
+        # returns found=false for an event that was just deleted.
+        # Ambiguous failures surface to the caller; provably-unsent
+        # ones (connect refused) still retry.
         quoted = urllib.parse.quote(event_id, safe="")
         _, payload = self._w.call(
             "DELETE", f"/storage/events/{quoted}.json",
-            _scope(app_id, channel_id))
+            _scope(app_id, channel_id), op="delete", idempotent=False)
         return bool(payload.get("found"))
 
     def delete_until(self, app_id, until_time,
                      channel_id: Optional[int] = None) -> int:
+        # idempotent=False for the same reason as delete(): a replayed
+        # attempt reports removed=0 after the first removed N.
         p = _scope(app_id, channel_id)
         p["untilTime"] = until_time.isoformat()
-        _, payload = self._w.call("POST", "/storage/delete_until.json", p)
+        _, payload = self._w.call("POST", "/storage/delete_until.json", p,
+                                  op="delete_until", idempotent=False)
         return int(payload.get("removed", 0))
 
     def aggregate_properties(self, app_id, entity_type, channel_id=None,
@@ -262,7 +578,8 @@ class RestLEvents(base.LEvents):
         if until_time is not None:
             p["untilTime"] = until_time.isoformat()
         status, payload = self._w.call(
-            "GET", "/storage/aggregate.json", p, ok=(200, 404))
+            "GET", "/storage/aggregate.json", p, ok=(200, 404),
+            op="aggregate")
         if status == 404:
             # super() does the hit/replay accounting for this path
             return super().aggregate_properties(
@@ -324,7 +641,7 @@ class RestLEvents(base.LEvents):
         # split on BYTES, decode complete lines: a multibyte character
         # straddling a network-chunk boundary must not be corrupted
         tail = b""
-        for chunk in self._w.stream(p):
+        for chunk in self._w.stream(p, op="find"):
             buf = tail + chunk
             lines = buf.split(b"\n")
             tail = lines.pop()
@@ -372,7 +689,8 @@ class RestPEvents(base.LEventsBackedPEvents):
                 for i in range(0, len(block), block_size):
                     yield block.take(slice(i, i + block_size))
 
-        for chunk in self._w.stream(_scope(app_id, channel_id)):
+        for chunk in self._w.stream(_scope(app_id, channel_id),
+                                    op="find_columnar_blocks"):
             buf.extend(chunk)
             if len(buf) >= BITE:
                 cut = buf.rfind(b"\n")

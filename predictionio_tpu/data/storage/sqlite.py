@@ -349,6 +349,9 @@ _EVENT_COLS = ("event_id, event, entity_type, entity_id, target_entity_type, "
 
 class SqliteLEvents(base.LEvents):
     metrics_backend = "sqlite"
+    # INSERT OR REPLACE keyed by (app, channel, event_id): retried
+    # inserts with pre-assigned ids replay to the identical state
+    idempotent_event_writes = True
 
     def __init__(self, config: Optional[dict] = None):
         config = config or {}

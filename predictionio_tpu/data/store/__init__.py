@@ -159,7 +159,8 @@ class _DaemonReadPool:
 
     def _worker(self) -> None:
         while True:
-            fn, box, done = self._tasks.get()
+            fn, box, done, started = self._tasks.get()
+            started.set()
             try:
                 box.append((True, fn()))
             except BaseException as e:  # delivered to the waiter
@@ -178,8 +179,9 @@ class _DaemonReadPool:
                 t.start()
         box: list = []
         done = threading.Event()
-        self._tasks.put((fn, box, done))
-        return box, done
+        started = threading.Event()
+        self._tasks.put((fn, box, done, started))
+        return box, done, started
 
 
 _read_pool = None
@@ -194,24 +196,83 @@ def _pool() -> _DaemonReadPool:
         return _read_pool
 
 
+# (DAO instance, its breaker): re-resolved only when storage.reset()
+# swaps the DAO — predict-time reads must not pay the process-global
+# breaker-registry lock per call
+_breaker_cache: Tuple[Any, Any] = (None, None)
+
+
+def _event_store_breaker():
+    """The circuit breaker guarding the EVENTDATA backend this process
+    reads at predict time (None when storage is not resolvable)."""
+    global _breaker_cache
+    from predictionio_tpu.utils import resilience
+
+    try:
+        le = storage.get_levents()
+    except Exception:
+        return None
+    cached_le, cached_br = _breaker_cache
+    if cached_le is le:
+        return cached_br
+    ep = resilience.endpoint_of(le)
+    br = resilience.breaker_for(ep) if ep else None
+    _breaker_cache = (le, br)
+    return br
+
+
 def _bounded(fn, timeout: Optional[float]):
     """Run ``fn`` with an optional deadline (seconds). ``None`` = direct
     call (no extra thread hop on the common local-backend path). The
     deadline path hops to a pool thread, which would otherwise lose the
     caller's request-id/trace contextvars — exactly where slow-read
-    attribution matters most — so the snapshot rides along."""
-    if timeout is None:
-        return fn()
-    from predictionio_tpu.utils.tracing import carrying_context
+    attribution matters most — so the snapshot rides along.
 
-    box, done = _pool().submit(carrying_context(fn))
-    if not done.wait(timeout):
-        raise LEventStoreTimeoutError(
-            f"event-store read exceeded {timeout}s")
-    ok, value = box[0]
-    if ok:
-        return value
-    raise value
+    Resilience wiring: when the event store's circuit breaker is open,
+    the read fails IMMEDIATELY (no pool hop, no timeout wait — a
+    blacked-out store must cost a query microseconds, not its full
+    deadline). Every failure marks the active
+    :func:`~predictionio_tpu.utils.resilience.degraded_scope` before
+    propagating, so templates that swallow the error and serve from the
+    device factor store still get the response stamped ``degraded``."""
+    from predictionio_tpu.utils import resilience
+
+    # the kill switch bypasses the breaker HERE too (consulting or
+    # feeding it while disabled would let state accumulate invisibly)
+    br = _event_store_breaker() if resilience.enabled() else None
+    if br is not None and br.is_blocking:
+        from predictionio_tpu.data.storage.base import StorageCircuitOpen
+
+        resilience.mark_degraded("circuit_open")
+        raise StorageCircuitOpen(br.endpoint, br.retry_in)
+    try:
+        if timeout is None:
+            return fn()
+        from predictionio_tpu.utils.tracing import carrying_context
+
+        box, done, started = _pool().submit(carrying_context(fn))
+        if not done.wait(timeout):
+            err = LEventStoreTimeoutError(
+                f"event-store read exceeded {timeout}s")
+            if br is not None and started.is_set():
+                # a HUNG store never raises inside the DAO (where op
+                # failures are normally counted) — the deadline here is
+                # the only layer that sees it, and without this a
+                # wedged backend would cost every query its full read
+                # timeout instead of tripping the fast-fail breaker.
+                # A task still QUEUED behind busy workers says nothing
+                # about the store: counting client-side congestion as
+                # endpoint failures would open the breaker (and flip
+                # every replica's /healthz) on a healthy backend.
+                br.record_failure(err)
+            raise err
+        ok, value = box[0]
+        if ok:
+            return value
+        raise value
+    except BaseException as e:
+        resilience.mark_degraded(resilience.degrade_reason_for(e))
+        raise
 
 
 class LEventStore:

@@ -328,6 +328,28 @@ def choose_server(user_factors, item_factors,
                n_users=n_users, n_items=n_items)
 
 
+class QueryRejectedError(RuntimeError):
+    """A query waited in the micro-batcher queue past the configured
+    deadline and was rejected instead of queuing indefinitely. The
+    query server renders this as HTTP 503 with a ``Retry-After``
+    header — under overload, shedding load fast beats building an
+    unbounded queue of doomed waiters."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = float(retry_after)
+
+
+def _queue_deadline() -> Optional[float]:
+    """``PIO_QUERY_QUEUE_DEADLINE`` (seconds a query may WAIT in the
+    micro-batch queue before a fast 503; <= 0 disables). Default 10s:
+    far above any healthy dispatch, far below a client giving up."""
+    from predictionio_tpu.utils.resilience import _env_float
+
+    val = _env_float("PIO_QUERY_QUEUE_DEADLINE", 10.0)
+    return val if val > 0 else None
+
+
 class _PendingQuery:
     __slots__ = ("uid", "k", "done", "result", "error")
 
@@ -372,6 +394,9 @@ class _MicroBatcher:
         # restarts — unlocked += here raced with those reads
         self.dispatches = 0      # stats: device dispatches issued
         self.batched_queries = 0  # stats: queries served through them
+        # queue deadline resolved ONCE (env read off the submit path);
+        # a server restart picks up a changed PIO_QUERY_QUEUE_DEADLINE
+        self._deadline = _queue_deadline()
 
     def stats(self) -> Dict[str, int]:
         """Consistent stats snapshot (one lock acquisition)."""
@@ -403,7 +428,29 @@ class _MicroBatcher:
             self._pending.append(item)
             self._set_queue_gauge_locked()
             self._cv.notify()
-        item.done.wait()
+        deadline = self._deadline
+        if not item.done.wait(deadline):
+            # still waiting past the deadline: if the item is STILL in
+            # the queue, yank it and fail fast — the client gets a 503
+            # + Retry-After instead of an unbounded wait. If it was
+            # already drained into an in-flight dispatch, the result is
+            # imminent (the dispatch owns it); block for it.
+            with self._cv:
+                if item in self._pending:
+                    self._pending.remove(item)
+                    self._set_queue_gauge_locked()
+                    rejected = True
+                else:
+                    rejected = False
+            if rejected:
+                from predictionio_tpu.utils import metrics
+
+                metrics.MICROBATCH_REJECTIONS.inc(batcher=self.name)
+                raise QueryRejectedError(
+                    f"query queued past {deadline}s without a device "
+                    "dispatch slot; retry shortly",
+                    retry_after=min(5.0, max(1.0, deadline / 4)))
+            item.done.wait()
         if item.error is not None:
             raise item.error
         return item.result

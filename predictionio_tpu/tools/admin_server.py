@@ -151,6 +151,14 @@ class AdminServer:
             self._httpd.server_close()
             self._httpd = None
 
+    def health_checks(self) -> Dict[str, bool]:
+        """Readiness for ``GET /healthz``: the metadata/event storage
+        this server administers resolves and its breaker is closed."""
+        from predictionio_tpu.utils import resilience
+
+        return {"storage": resilience.storage_ready(
+            self.client.registry.get_levents)}
+
     # -- request handling --------------------------------------------------
     def handle(self, method: str, path: str,
                body: bytes) -> Tuple[int, Dict[str, Any]]:
@@ -195,7 +203,8 @@ class _AdminHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         logger.debug(fmt, *args)
 
     def _route_label(self, path: str) -> str:
-        if path in ("/", "/metrics", "/traces.json", "/cmd/app"):
+        if path in ("/", "/healthz", "/metrics", "/traces.json",
+                    "/cmd/app"):
             return path
         if path.startswith("/traces/"):
             return "/traces/<id>"
@@ -214,6 +223,9 @@ class _AdminHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         path = parsed.path.rstrip("/") or "/"
 
         def handle() -> None:
+            if method == "GET" and path == "/healthz":
+                self._respond_healthz(self.admin_server.health_checks())
+                return
             if method == "GET" and path == "/metrics":
                 self._respond_prometheus()
                 return

@@ -59,6 +59,14 @@ class Dashboard:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
+    def health_checks(self):
+        """Readiness for ``GET /healthz``: the metadata storage the
+        instance list reads resolves and its breaker is closed."""
+        from predictionio_tpu.utils import resilience
+
+        return {"storage": resilience.storage_ready(
+            self.registry.get_levents)}
+
     def start(self) -> "Dashboard":
         server = self
 
@@ -210,7 +218,7 @@ class _DashboardHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         logger.debug(fmt, *args)
 
     def _route_label(self, path: str) -> str:
-        if path in ("/", "/metrics"):
+        if path in ("/", "/healthz", "/metrics"):
             return path
         parts = [p for p in path.split("/") if p]
         if parts and parts[0] == "engine_instances" and len(parts) == 3:
@@ -227,6 +235,9 @@ class _DashboardHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         path = parsed.path.rstrip("/") or "/"
 
         def handle() -> None:
+            if path == "/healthz":
+                self._respond_healthz(self.dashboard.health_checks())
+                return
             if path == "/metrics":
                 self._respond_prometheus()
                 return

@@ -82,6 +82,18 @@ class InstrumentedHandlerMixin:
             200, metrics.registry().render_prometheus().encode("utf-8"),
             "text/plain; version=0.0.4; charset=utf-8")
 
+    def _respond_healthz(self, checks: Mapping[str, bool]) -> None:
+        """``GET /healthz`` — liveness + readiness in one probe, the
+        same shape on all four servers. Answering at all IS liveness;
+        readiness is the AND of the server's checks (deployment
+        loaded, storage breaker closed, ...), with 503 telling the
+        load balancer to route elsewhere while the process stays up."""
+        checks = {k: bool(v) for k, v in checks.items()}
+        ready = all(checks.values())
+        self._respond(200 if ready else 503,
+                      {"alive": True, "ready": ready, "checks": checks,
+                       "server": self.metrics_server_label})
+
     # -- trace endpoints ---------------------------------------------------
     @staticmethod
     def _q_first(query: Optional[Dict[str, List[str]]], key: str
@@ -132,8 +144,8 @@ class InstrumentedHandlerMixin:
     # worth keeping. A caller who SENDS a traceparent is explicitly
     # tracing, so these routes still join an existing trace (retention
     # then rides the caller's sampling decision).
-    _UNTRACED_ROUTES = ("/", "/metrics", "/stats.json", "/traces.json",
-                        "/traces/<id>")
+    _UNTRACED_ROUTES = ("/", "/healthz", "/metrics", "/stats.json",
+                        "/traces.json", "/traces/<id>")
 
     # -- dispatch shell ----------------------------------------------------
     def _dispatch_instrumented(self, method: str, path: str,

@@ -507,6 +507,38 @@ STORAGE_OP_ERRORS = REGISTRY.counter(
     "Event-store DAO operation failures by backend, op and error class",
     ("backend", "op", "error"))
 
+# -- resilience (retries, breakers, degradation, fault injection) ----------
+STORAGE_RETRIES = REGISTRY.counter(
+    "pio_storage_retries_total",
+    "Storage-op retry attempts by backend and op (each retry masked one "
+    "transient failure)",
+    ("backend", "op"))
+CIRCUIT_STATE = REGISTRY.gauge(
+    "pio_circuit_state",
+    "Circuit-breaker state per endpoint (0 closed, 1 open, 2 half-open)",
+    ("endpoint",))
+CIRCUIT_TRANSITIONS = REGISTRY.counter(
+    "pio_circuit_transitions_total",
+    "Circuit-breaker state transitions by endpoint and target state",
+    ("endpoint", "to"))
+DEGRADED_QUERIES = REGISTRY.counter(
+    "pio_degraded_queries_total",
+    "Queries answered in degraded mode (storage down / breaker open / "
+    "read timed out) instead of failing",
+    ("reason",))
+FEEDBACK_DROPPED = REGISTRY.counter(
+    "pio_feedback_dropped_total",
+    "Feedback-loop predict events dropped after the bounded retry", ())
+MICROBATCH_REJECTIONS = REGISTRY.counter(
+    "pio_microbatch_rejections_total",
+    "Queries rejected (503 + Retry-After) after waiting past the "
+    "micro-batcher queue deadline",
+    ("batcher",))
+FAULTS_INJECTED = REGISTRY.counter(
+    "pio_faults_injected_total",
+    "Faults fired by the PIO_FAULTS deterministic injection harness",
+    ("backend", "op", "kind"))
+
 # -- materialized entity-property aggregation (PR 1) -----------------------
 AGGREGATE_HITS = REGISTRY.counter(
     "pio_aggregate_hits_total",

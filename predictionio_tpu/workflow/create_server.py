@@ -46,8 +46,10 @@ from predictionio_tpu.controller.engine import (
 from predictionio_tpu.core.base import WorkflowParams
 from predictionio_tpu.core.context import ComputeContext, workflow_context
 from predictionio_tpu.data import storage
+from predictionio_tpu.data.event import new_event_id
 from predictionio_tpu.data.storage.base import EngineInstance, StorageError
-from predictionio_tpu.utils import metrics
+from predictionio_tpu.ops.serving import QueryRejectedError
+from predictionio_tpu.utils import metrics, resilience
 from predictionio_tpu.utils.http_instrumentation import (
     InstrumentedHandlerMixin,
 )
@@ -351,6 +353,65 @@ def serve_query(dep: Deployment, query: Any) -> Any:
         return dep.serving.serve_base(query, predictions)
 
 
+_device_ok: Optional[bool] = None
+_device_probe_at = 0.0
+_device_probe_thread: Optional[threading.Thread] = None
+_device_probe_lock = threading.Lock()
+_DEVICE_PROBE_TIMEOUT = 10.0
+
+
+def _device_reachable() -> bool:
+    """Accelerator probe for readiness. SUCCESS is cached forever
+    (device topology does not change under a live server, and a
+    healthz poll must never pay a jax backend init); FAILURE is cached
+    for 60s only — a flaky tunnel that recovers must flip readiness
+    back without a restart, but a dead one must not hang every poll.
+    The probe itself runs on a daemon thread with a bounded join: a
+    dead PJRT tunnel BLOCKS inside jax.local_devices() forever (the
+    exact hang bench.py's _device_watchdog guards against), and
+    healthz liveness is the response itself — it must always return.
+    While a probe is still in flight, polls report not-ready without
+    stacking further probe threads."""
+    global _device_ok, _device_probe_at, _device_probe_thread
+    if _device_ok:
+        return True
+    # the check-then-act is locked so concurrent polls spawn exactly
+    # ONE probe thread; the probe is REGISTERED before the bounded join
+    # so every other concurrent poll fails fast instead of stalling
+    with _device_probe_lock:
+        if _device_ok:
+            return True
+        now = time.monotonic()
+        if _device_probe_thread is not None:
+            if _device_probe_thread.is_alive():
+                return False  # a probe is already wedged in the plugin
+            _device_probe_thread = None
+        if _device_ok is False and now - _device_probe_at < 60.0:
+            return False
+        _device_probe_at = now
+
+        def probe() -> None:
+            global _device_ok
+            try:
+                import jax
+
+                _device_ok = len(jax.local_devices()) > 0
+            except Exception:
+                _device_ok = False
+
+        t = threading.Thread(target=probe, name="pio-device-probe",
+                             daemon=True)
+        t.start()
+        _device_probe_thread = t
+    t.join(_DEVICE_PROBE_TIMEOUT)
+    with _device_probe_lock:
+        if t.is_alive():  # hung: not ready; later polls see the thread
+            return False
+        if _device_probe_thread is t:
+            _device_probe_thread = None
+        return bool(_device_ok)
+
+
 class QueryServer:
     """The deployment daemon (MasterActor + ServerActor combined)."""
 
@@ -434,12 +495,30 @@ class QueryServer:
             logger.error("Query %r is invalid. Reason: %s", query_dict, e)
             return 400, {"message": str(e)}
         try:
-            prediction = self._predict(dep, query)
+            # graceful degradation: predict-time storage reads that
+            # fail (event store down, breaker open, deadline hit) mark
+            # the scope instead of failing the query — the device
+            # factor store still answers, and the response says so
+            with resilience.degraded_scope() as degraded:
+                prediction = self._predict(dep, query)
+        except QueryRejectedError as e:
+            # queue overload: fail FAST with the server's own pacing
+            # hint, never an opaque 500 (micro-batcher deadline)
+            return 503, {"message": str(e),
+                         "retryAfterSec": e.retry_after}
         except Exception as e:
             logger.exception("query failed")
             return 500, {"message": str(e)}
 
         result = to_jsonable(prediction)
+        if degraded:
+            # the query WAS served degraded whatever its result shape —
+            # count always; the response field needs a JSON object
+            for reason in degraded:
+                metrics.DEGRADED_QUERIES.inc(reason=reason)
+            if isinstance(result, dict):
+                result["degraded"] = True
+                result["degradedReasons"] = list(degraded)
         if self.config.feedback:
             result = self._feedback(dep, query_dict, query, prediction,
                                     result, query_time)
@@ -468,6 +547,10 @@ class QueryServer:
         pr_id = org or secrets.token_hex(32)
         data = {
             "event": "predict",
+            # client-generated id = idempotency key: if the retried
+            # POST's first attempt committed before its response was
+            # lost, id-keyed backends dedup instead of double-counting
+            "eventId": new_event_id(),
             "eventTime": query_time.isoformat(),
             "entityType": "pio_pr",
             "entityId": pr_id,
@@ -487,19 +570,47 @@ class QueryServer:
         # server's spans for the feedback insert join the query's trace
         headers = {"Content-Type": "application/json",
                    **outbound_context_headers()}
+        body = json.dumps(data).encode("utf-8")
 
         def post():
-            try:
-                req = urllib.request.Request(
-                    url, data=json.dumps(data).encode("utf-8"),
-                    headers=headers, method="POST")
-                with urllib.request.urlopen(req, timeout=10) as resp:
-                    if resp.status != 201:
+            # bounded: ONE retry, then drop with a counter. Feedback is
+            # telemetry — it runs on a detached daemon thread and must
+            # never delay or fail the query response, so an unreachable
+            # event server costs at most two short attempts here.
+            last: Optional[Exception] = None
+            for attempt in range(2):
+                try:
+                    req = urllib.request.Request(
+                        url, data=body, headers=headers, method="POST")
+                    with urllib.request.urlopen(req, timeout=5) as resp:
+                        if resp.status == 201:
+                            return
+                        # 2xx/3xx that is not 201 — a retry with the
+                        # same payload cannot change the server's mind
                         logger.error(
                             "Feedback event failed. Status code: %d. "
                             "Data: %s.", resp.status, data)
-            except Exception as e:
-                logger.error("Feedback event failed: %s", e)
+                        metrics.FEEDBACK_DROPPED.inc()
+                        return
+                except urllib.error.HTTPError as e:
+                    if e.code < 500:
+                        # the server REFUSED (4xx = our payload's
+                        # fault): retrying the identical payload is
+                        # pointless — drop now
+                        logger.error(
+                            "Feedback event refused (%d). Data: %s.",
+                            e.code, data)
+                        metrics.FEEDBACK_DROPPED.inc()
+                        return
+                    last = e
+                    if attempt == 0:
+                        time.sleep(0.2)
+                except Exception as e:
+                    last = e
+                    if attempt == 0:
+                        time.sleep(0.2)
+            metrics.FEEDBACK_DROPPED.inc()
+            logger.error("Feedback event dropped after retry: %s", last)
 
         threading.Thread(target=post, daemon=True,
                          name="pio-feedback").start()
@@ -548,6 +659,17 @@ class QueryServer:
         pio_storage_op_* ... — the same state GET /metrics renders as
         Prometheus text)."""
         return {**self.status(), "metrics": metrics.registry().snapshot()}
+
+    def health_checks(self) -> Dict[str, bool]:
+        """Readiness for ``GET /healthz``: a deployment is loaded, the
+        accelerator answers, and the event-store breaker is not
+        refusing calls. Liveness is the response itself; readiness
+        going false tells the balancer to drain THIS replica while it
+        keeps serving (degraded) what it can."""
+        checks = {"deployment": self._deployment is not None,
+                  "device": _device_reachable()}
+        checks["storage"] = resilience.storage_ready(storage.get_levents)
+        return checks
 
     # -- HTTP lifecycle ----------------------------------------------------
     def start(self, undeploy_stale: bool = True,
@@ -663,8 +785,9 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         return self.rfile.read(length) if length else b""
 
-    _ROUTES = ("/", "/metrics", "/stats.json", "/plugins.json",
-               "/queries.json", "/reload", "/stop", "/traces.json")
+    _ROUTES = ("/", "/healthz", "/metrics", "/stats.json",
+               "/plugins.json", "/queries.json", "/reload", "/stop",
+               "/traces.json")
 
     def _route_label(self, path: str) -> str:
         if path.startswith("/traces/"):
@@ -684,6 +807,8 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         self._drain()
         if path == "/":
             self._respond(200, srv.status())
+        elif path == "/healthz":
+            self._respond_healthz(srv.health_checks())
         elif path == "/metrics":
             self._respond_prometheus()
         elif path == "/stats.json":
@@ -703,7 +828,17 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         try:
             if path == "/queries.json":
                 status, payload = srv.handle_query(body)
-                self._respond(status, payload)
+                if status == 503 and isinstance(payload, dict) \
+                        and payload.get("retryAfterSec") is not None:
+                    # overload rejections carry the standard header so
+                    # plain HTTP clients back off without parsing JSON
+                    retry_in = max(1, int(payload["retryAfterSec"]))
+                    self._respond_bytes(
+                        status, json.dumps(payload).encode("utf-8"),
+                        "application/json; charset=UTF-8",
+                        extra_headers={"Retry-After": str(retry_in)})
+                else:
+                    self._respond(status, payload)
             elif path == "/reload":
                 iid = srv.reload()
                 self._respond(200, {"message": "Reloading...",
