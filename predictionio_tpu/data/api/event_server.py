@@ -330,6 +330,15 @@ class EventServer:
             return 404, {"message": "To see stats, launch Event Server with "
                                     "--stats argument."}
         payload = self.stats_keeper.get(auth.app_id)
+        # per-(app, channel) stream-end watermark (last appended event id
+        # + time + the tail cursor): the observability hook the online
+        # fold-in freshness story reads — "how far does the stream go"
+        # next to the query server's "how far have I folded"
+        try:
+            payload["tailWatermark"] = self.event_client.tail_watermark(
+                auth.app_id, auth.channel_id)
+        except Exception:
+            payload["tailWatermark"] = None  # backend keeps no cheap tail
         # richer than the reference shape: the process-wide registry
         # snapshot rides along. The caller authed for ONE app, so
         # app-labeled series are filtered to it — the reference's
@@ -532,6 +541,61 @@ class EventServer:
         return 200, {"removed":
                      self.event_client.delete_until(app_id, until, ch)}
 
+    def storage_tail(self, query,
+                     body: Optional[bytes] = None) -> Tuple[int, Any]:
+        """Tail-read wire (``GET``/``POST /storage/tail.json``): the
+        remote-DAO lane for ``find_since`` / ``tail_cursor`` /
+        ``tail_watermark`` — what a deployed query server's online
+        fold-in consumer polls when its event store lives in this
+        process. The cursor is the backend's opaque JSON, passed
+        through verbatim both ways; POST carries it in the request body
+        (a jsonlfs watermark grows one entry per partition, and a large
+        store's cursor would overflow the request-line cap as a query
+        parameter)."""
+        app_id, ch = self._storage_scope(query)
+        le = self.event_client
+        if _first(query, "watermark") == "true":
+            return 200, {"watermark": le.tail_watermark(app_id, ch)}
+        if _first(query, "position") == "end":
+            return 200, {"cursor": le.tail_cursor(app_id, ch)}
+        cursor = None
+        limit = None
+        if body:
+            try:
+                parsed = json.loads(body.decode("utf-8"))
+                if not isinstance(parsed, dict):
+                    raise ValueError("body must be a JSON object")
+            except (json.JSONDecodeError, UnicodeDecodeError,
+                    ValueError) as e:
+                raise _HttpError(400, {"message": f"invalid body: {e}"})
+            cursor = parsed.get("cursor")
+            if cursor is not None and not isinstance(cursor, dict):
+                raise _HttpError(
+                    400, {"message": "invalid cursor: must be a JSON "
+                                     "object"})
+            if parsed.get("limit") is not None:
+                limit = _int_param(str(parsed["limit"]), "limit")
+        raw = _first(query, "cursor")
+        if cursor is None and raw:
+            try:
+                cursor = json.loads(raw)
+                if not isinstance(cursor, dict):
+                    raise ValueError("cursor must be a JSON object")
+            except (json.JSONDecodeError, ValueError) as e:
+                raise _HttpError(400, {"message": f"invalid cursor: {e}"})
+        limit_s = _first(query, "limit")
+        if limit is None and limit_s is not None:
+            limit = _int_param(limit_s, "limit")
+        if limit is None:
+            # server-side cap: a limit-less tail read would materialize
+            # the ENTIRE store as one list + one unchunked response (the
+            # bulk-read lane is the streaming /storage/events.jsonl);
+            # callers page through the returned cursor
+            limit = 10_000
+        events, cur = le.find_since(app_id, ch, cursor=cursor, limit=limit)
+        return 200, {"events": [e.to_dict() for e in events],
+                     "cursor": cur}
+
     def storage_aggregate(self, query) -> Tuple[int, Any]:
         """Server-side ``aggregate_properties`` for the remote-DAO lane:
         unbounded calls answer from the backend's MATERIALIZED state, so
@@ -720,7 +784,7 @@ class _EventHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
                     "/batch/events.json", "/plugins.json", "/traces.json",
                     "/storage/events.jsonl", "/storage/init.json",
                     "/storage/remove.json", "/storage/delete_until.json",
-                    "/storage/aggregate.json"):
+                    "/storage/aggregate.json", "/storage/tail.json"):
             return path
         if path.startswith("/traces/"):
             return "/traces/<id>"
@@ -868,6 +932,10 @@ class _EventHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
             return
         elif path == "/storage/aggregate.json" and method == "GET":
             self._respond(*srv.storage_aggregate(query))
+            return
+        elif path == "/storage/tail.json" and method in ("GET", "POST"):
+            self._respond(*srv.storage_tail(
+                query, self._request_body if method == "POST" else None))
             return
         elif path.startswith("/storage/events/") and path.endswith(".json"):
             # clients percent-encode ids with reserved characters

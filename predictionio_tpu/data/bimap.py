@@ -97,6 +97,34 @@ class StringIndexBiMap(BiMap):
         """Object ndarray such that labels[i] == key with index i."""
         return self._labels
 
+    def append(self, labels: Sequence[str]) -> List[int]:
+        """Extend the map with NEW labels in place, assigning the next
+        dense indices; returns their indices. Labels already present are
+        an error — the caller (online fold-in growing the user universe
+        under a live server) resolves known ids first. Publish order
+        matters for lock-free readers: the factor store must be patched
+        BEFORE the labels land here, so a predict-path ``get`` never
+        resolves an index the store does not hold yet."""
+        new = [str(k) for k in labels]
+        if len(set(new)) != len(new):
+            # an intra-batch duplicate would pass the per-label check
+            # below (neither copy is mapped yet) and then permanently
+            # misalign _fwd and _labels — one fwd entry, two label rows
+            raise ValueError("append: duplicate labels within the batch")
+        for k in new:
+            if k in self._fwd:
+                raise ValueError(f"label {k!r} already mapped")
+        base = len(self._fwd)
+        out = []
+        for i, k in enumerate(new):
+            self._fwd[k] = base + i
+            out.append(base + i)
+        if new:
+            self._labels = np.concatenate(
+                [self._labels, np.asarray(new, dtype=object)])
+            self._inv = None  # lazy inverse rebuilt on next inv_get
+        return out
+
     def decode(self, indices) -> np.ndarray:
         """Vectorized index->key decoding (for top-k model outputs)."""
         return self._labels[np.asarray(indices)]

@@ -199,6 +199,12 @@ class JsonlFsLEvents(base.LEvents):
             # data deleted while partitions remain on disk); the .lock
             # file itself is part of the tree and goes with it
             shutil.rmtree(d)
+            # the tail generation lives BESIDE the directory and so
+            # survives this: a re-created scope re-issues the same
+            # partition names, and enough re-ingest would push part
+            # sizes past a pre-remove cursor's offsets — without the
+            # bump that cursor would silently skip the re-landed events
+            self._bump_tail_gen(d)
         return True
 
     def close(self) -> None:
@@ -386,12 +392,192 @@ class JsonlFsLEvents(base.LEvents):
             out = out[:limit]
         return iter(out)
 
+    # -- tail reads (find_since contract, base.py) -------------------------
+    # The cursor IS a per-partition byte watermark — the same shape the
+    # PR-1 materialized-aggregation snapshot records (``_delta_lines``),
+    # reused here as a consumer-owned position: arrival order is file
+    # order, unterminated tails are never consumed (their offset stays
+    # before them), and a partition rewrite (delete/delete_until) that
+    # moved bytes under the offsets resets the cursor to a full replay.
+    # Rewrites are detected two ways: a partition now SHORTER than its
+    # recorded offset, and a per-directory rewrite generation carried in
+    # the cursor — the latter catches a rewrite whose partition has
+    # since been appended back past the stale offset (names survive
+    # rewrites, so sizes alone cannot prove the bytes under an offset
+    # are the ones the cursor consumed).
+
+    @staticmethod
+    def _gen_path(d: str) -> str:
+        # a SIBLING of the scope directory, not inside it: remove()
+        # deletes the whole tree, and the generation must survive a
+        # remove + re-init (same partition names come back)
+        return d.rstrip(os.sep) + ".tail_gen"
+
+    def _tail_gen(self, d: str) -> int:
+        try:
+            with open(self._gen_path(d), "r", encoding="ascii") as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def _bump_tail_gen(self, d: str) -> None:
+        """Caller holds the directory lock (rewrite/remove paths only)."""
+        try:
+            atomic_write_bytes(self._gen_path(d),
+                               str(self._tail_gen(d) + 1).encode("ascii"))
+        except OSError:
+            # a read-only tree cannot be rewritten either, so there is
+            # no offset movement to signal
+            pass
+
+    @staticmethod
+    def _complete_size(path: str) -> int:
+        """Byte offset just past the last COMPLETE (newline-terminated)
+        line — the tail-cursor boundary: an offset inside a torn or
+        in-flight final line would make the next read start mid-line
+        and silently lose that event once it completes."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return 0
+        if size == 0:
+            return 0
+        with open(path, "rb") as f:
+            f.seek(size - 1)
+            if f.read(1) == b"\n":
+                return size
+            end = size - 1
+            chunk = 1 << 16
+            while end > 0:
+                start = max(0, end - chunk)
+                f.seek(start)
+                data = f.read(end - start)
+                cut = data.rfind(b"\n")
+                if cut >= 0:
+                    return start + cut + 1
+                end = start
+        return 0
+
+    def find_since(self, app_id, channel_id=None, cursor=None, limit=None):
+        d = self._dir(app_id, channel_id)
+        if not os.path.isdir(d):
+            return [], {"kind": "jsonlfs", "watermark": {}, "gen": 0}
+        wm = dict((cursor or {}).get("watermark", {}) or {})
+        events: List[Event] = []
+        with self._dir_lock(d):
+            gen = self._tail_gen(d)
+            parts = self._parts(d)
+            names = {os.path.basename(p) for p in parts}
+            stale = wm and (
+                int((cursor or {}).get("gen", 0)) != gen
+                or any(n not in names
+                       or os.path.getsize(os.path.join(d, n)) < int(off)
+                       for n, off in wm.items()))
+            if stale:
+                # a rewrite moved bytes under the offsets: replay from
+                # the start (replay-tolerant consumer contract)
+                wm = {}
+            new_wm = dict(wm)
+            full = False
+            for part in parts:
+                name = os.path.basename(part)
+                off = int(wm.get(name, 0))
+                end = self._complete_size(part)
+                if end > off:
+                    with open(part, "rb") as f:
+                        f.seek(off)
+                        data = f.read(end - off)
+                    consumed = 0
+                    for raw in data.split(b"\n")[:-1]:
+                        if limit is not None and len(events) >= int(limit):
+                            full = True
+                            break
+                        consumed += len(raw) + 1
+                        raw = raw.strip()
+                        if raw:
+                            e = _parse_event_line(
+                                raw.decode("utf-8", errors="replace"),
+                                part)
+                            if e is not None:
+                                events.append(e)
+                    off += consumed
+                new_wm[name] = off
+                if full:
+                    break
+        return events, {"kind": "jsonlfs", "watermark": new_wm,
+                        "gen": gen}
+
+    def tail_cursor(self, app_id, channel_id=None):
+        d = self._dir(app_id, channel_id)
+        wm: Dict[str, int] = {}
+        gen = 0
+        if os.path.isdir(d):
+            with self._dir_lock(d):
+                gen = self._tail_gen(d)
+                for part in self._parts(d):
+                    wm[os.path.basename(part)] = self._complete_size(part)
+        return {"kind": "jsonlfs", "watermark": wm, "gen": gen}
+
+    def tail_watermark(self, app_id, channel_id=None):
+        d = self._dir(app_id, channel_id)
+        out = {"cursor": {"kind": "jsonlfs", "watermark": {}, "gen": 0},
+               "lastEventId": None, "lastEventTime": None}
+        if not os.path.isdir(d):
+            return out
+        last: Optional[Event] = None
+        with self._dir_lock(d):
+            out["cursor"]["gen"] = self._tail_gen(d)
+            parts = self._parts(d)
+            wm = {os.path.basename(p): self._complete_size(p)
+                  for p in parts}
+            for part in reversed(parts):
+                end = wm[os.path.basename(part)]
+                if end == 0:
+                    continue
+                # scan back in doubling windows: a window that starts
+                # mid-line truncates its first line into an unparsable
+                # fragment, so a single fixed-size window would report
+                # a STALE watermark whenever the final event line is
+                # bigger than it (large properties payloads)
+                window = 1 << 16
+                with open(part, "rb") as f:
+                    while last is None:
+                        start = max(0, end - window)
+                        f.seek(start)
+                        data = f.read(end - start)
+                        lines = [ln for ln in data.split(b"\n")
+                                 if ln.strip()]
+                        if start > 0:
+                            lines = lines[1:]  # possibly torn head
+                        for raw in reversed(lines):
+                            e = _parse_event_line(
+                                raw.decode("utf-8", errors="replace"),
+                                part)
+                            if e is not None:
+                                last = e
+                                break
+                        if start == 0:
+                            break
+                        window *= 2
+                if last is not None:
+                    break
+        out["cursor"]["watermark"] = wm
+        if last is not None:
+            out["lastEventId"] = last.event_id
+            out["lastEventTime"] = last.event_time.isoformat()
+        return out
+
     # -- materialized entity-property state (watermark snapshot) ----------
 
     def _invalidate_snapshot(self, d: str) -> None:
         """A partition rewrite moved bytes under the recorded offsets —
-        drop the snapshot so the next read refolds from scratch. Caller
-        holds the directory lock."""
+        drop the snapshot so the next read refolds from scratch, and
+        bump the tail generation so outstanding tail cursors reset to a
+        full replay (partition names survive a rewrite, so a shrink
+        followed by enough appends could otherwise push the file back
+        past a stale byte offset and silently skip the re-landed
+        bytes). Caller holds the directory lock."""
+        self._bump_tail_gen(d)
         with self._lock:
             self._snapshots.pop(d, None)
         try:

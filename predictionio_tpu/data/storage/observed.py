@@ -46,7 +46,10 @@ logger = logging.getLogger("pio.storage.ops")
 # events carry idempotency keys (event ids) a backend can dedup on
 _WRITE_OPS = frozenset({"insert", "insert_batch", "append_raw_lines"})
 
-# passthrough attributes that still deserve timing (optional per backend)
+# passthrough attributes that still deserve timing (optional per backend);
+# the tail-read trio (find_since/tail_cursor/tail_watermark) is declared
+# on base.LEvents so it never reaches __getattr__ — it gets explicit
+# timed+resilient delegation below instead
 _EXTRA_TIMED_OPS = ("append_raw_lines",)
 
 
@@ -280,6 +283,20 @@ class DAOMetricsWrapper(base.LEvents):
                 self._record("find", t0, error=e)
             tracing.finish_span(sp, error=e)
         return _TimedIterator(it, done, fail)
+
+    # the tail-read trio is defined on base.LEvents (so __getattr__ never
+    # fires for it) — delegate explicitly, timed + resilient like any op
+    def find_since(self, app_id, channel_id=None, cursor=None, limit=None):
+        return self._observe("find_since", self._wrapped.find_since,
+                             app_id, channel_id, cursor=cursor, limit=limit)
+
+    def tail_cursor(self, app_id, channel_id=None):
+        return self._observe("tail_cursor", self._wrapped.tail_cursor,
+                             app_id, channel_id)
+
+    def tail_watermark(self, app_id, channel_id=None):
+        return self._observe("tail_watermark", self._wrapped.tail_watermark,
+                             app_id, channel_id)
 
     def materialized_aggregate(self, app_id, entity_type, channel_id=None):
         return self._observe(

@@ -561,6 +561,47 @@ class RestLEvents(base.LEvents):
                                   op="delete_until", idempotent=False)
         return int(payload.get("removed", 0))
 
+    # -- tail reads (find_since contract, base.py) -------------------------
+    # The remote server's backend mints the cursor; it crosses the wire
+    # as opaque JSON both ways, so a resthttp consumer tails whatever
+    # store the event server actually runs (memory/sqlite/jsonlfs, or
+    # another resthttp hop).
+
+    def find_since(self, app_id, channel_id=None, cursor=None, limit=None):
+        p = _scope(app_id, channel_id)
+        if cursor is not None:
+            # the cursor rides in the request BODY: a jsonlfs watermark
+            # carries one entry per partition, and a big store's cursor
+            # in the query string would overflow the server's
+            # request-line cap (64 KB) — permanently wedging the tail
+            body = {"cursor": cursor}
+            if limit is not None:
+                body["limit"] = int(limit)
+            _, payload = self._w.call(
+                "POST", "/storage/tail.json", p,
+                body=json.dumps(body).encode("utf-8"), op="find_since")
+        else:
+            if limit is not None:
+                p["limit"] = int(limit)
+            _, payload = self._w.call("GET", "/storage/tail.json", p,
+                                      op="find_since")
+        events = [Event.from_dict(d) for d in payload.get("events", [])]
+        return events, payload.get("cursor") or {}
+
+    def tail_cursor(self, app_id, channel_id=None):
+        p = _scope(app_id, channel_id)
+        p["position"] = "end"
+        _, payload = self._w.call("GET", "/storage/tail.json", p,
+                                  op="tail_cursor")
+        return payload.get("cursor") or {}
+
+    def tail_watermark(self, app_id, channel_id=None):
+        p = _scope(app_id, channel_id)
+        p["watermark"] = "true"
+        _, payload = self._w.call("GET", "/storage/tail.json", p,
+                                  op="tail_watermark")
+        return payload.get("watermark")
+
     def aggregate_properties(self, app_id, entity_type, channel_id=None,
                              start_time=None, until_time=None,
                              required=None):

@@ -352,6 +352,10 @@ class SqliteLEvents(base.LEvents):
     # INSERT OR REPLACE keyed by (app, channel, event_id): retried
     # inserts with pre-assigned ids replay to the identical state
     idempotent_event_writes = True
+    # entity_id-filtered finds are index lookups, not table scans —
+    # readers (the fold-in gather) may issue many small per-entity
+    # reads instead of one shared scan
+    indexed_entity_reads = True
 
     def __init__(self, config: Optional[dict] = None):
         config = config or {}
@@ -760,6 +764,70 @@ class SqliteLEvents(base.LEvents):
         # callers may write while iterating.
         for row in self._client.query_iter(sql, args):
             yield _row_to_event(row)
+
+    # -- tail reads (find_since contract, base.py) -------------------------
+    # Arrival order = rowid order (INSERT OR REPLACE re-inserts, so an
+    # id-keyed upsert re-surfaces to tail consumers — re-delivery of the
+    # newest version, never a miss).
+
+    def find_since(self, app_id, channel_id=None, cursor=None, limit=None):
+        aid, chan = int(app_id), self._chan(channel_id)
+        after = int(cursor.get("rowid", -1)) if cursor else -1
+        last_eid = cursor.get("eventId") if cursor else None
+        if after >= 0:
+            # the cursor is self-validating: the row it points at must
+            # still exist AND still hold the event it held when the
+            # cursor was minted. A bulk delete followed by re-ingest
+            # RECYCLES rowids (sqlite hands out max+1, so trimming the
+            # tail re-issues the trimmed range) — a bare rowid compare
+            # against MAX(rowid) cannot see that, and would silently
+            # skip every event re-landed at a recycled rowid <= cursor.
+            row = self._client.query_one(
+                "SELECT event_id FROM events WHERE app_id=? AND"
+                " channel_id=? AND rowid=?", (aid, chan, after))
+            if row is None or (last_eid is not None
+                               and row[0] != last_eid):
+                after = -1
+                last_eid = None
+        sql = (f"SELECT {_EVENT_COLS}, rowid FROM events WHERE app_id=?"
+               f" AND channel_id=? AND rowid>? ORDER BY rowid ASC")
+        args: List[Any] = [aid, chan, after]
+        if limit is not None and int(limit) >= 0:
+            sql += f" LIMIT {int(limit)}"
+        events: List[Event] = []
+        last = after
+        for row in self._client.query_iter(sql, args):
+            events.append(_row_to_event(row[:-1]))
+            last = int(row[-1])
+        if events:
+            last_eid = events[-1].event_id
+        cur = {"kind": "sqlite", "rowid": last}
+        if last >= 0 and last_eid is not None:
+            cur["eventId"] = last_eid
+        return events, cur
+
+    def tail_cursor(self, app_id, channel_id=None):
+        row = self._client.query_one(
+            "SELECT rowid, event_id FROM events WHERE app_id=? AND"
+            " channel_id=? ORDER BY rowid DESC LIMIT 1",
+            (int(app_id), self._chan(channel_id)))
+        if row is None:
+            return {"kind": "sqlite", "rowid": -1}
+        return {"kind": "sqlite", "rowid": int(row[0]),
+                "eventId": row[1]}
+
+    def tail_watermark(self, app_id, channel_id=None):
+        row = self._client.query_one(
+            "SELECT event_id, event_time, rowid FROM events WHERE app_id=?"
+            " AND channel_id=? ORDER BY rowid DESC LIMIT 1",
+            (int(app_id), self._chan(channel_id)))
+        if row is None:
+            return {"cursor": {"kind": "sqlite", "rowid": -1},
+                    "lastEventId": None, "lastEventTime": None}
+        return {"cursor": {"kind": "sqlite", "rowid": int(row[2]),
+                           "eventId": row[0]},
+                "lastEventId": row[0],
+                "lastEventTime": _from_ts(row[1]).isoformat()}
 
 
 class SqlitePEvents(base.LEventsBackedPEvents):

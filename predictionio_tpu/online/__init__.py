@@ -1,0 +1,21 @@
+"""Online fold-in: fresh user factors inside the deployed server.
+
+ROADMAP item 3 — everything upstream of this package is batch: a new
+user or a just-ingested event is invisible to serving until the next
+full ``pio train`` + redeploy. This package closes that gap: a
+background consumer tails the event stream per (app, channel) through
+the storage layer's cursor reads (``LEvents.find_since``, all four
+event backends), accumulates per-user rating deltas, and on a
+configurable cadence solves the affected user rows against the FIXED
+item factors with the jitted batch-k fold-in kernel
+(:func:`predictionio_tpu.ops.als.fold_in_users`) — then patches the
+live :class:`~predictionio_tpu.ops.serving.DeviceTopK` store in place.
+New users are servable within seconds of their first events, with no
+``/reload`` and no retrain.
+"""
+
+from predictionio_tpu.online.foldin import (  # noqa: F401
+    FoldInConfig,
+    FoldInConsumer,
+    attach_foldin,
+)

@@ -109,6 +109,12 @@ class PaddedRatings:
             else self.n_valid_rows
 
 
+# rows pad to a multiple of this in every solve-table builder
+# (pad_ratings, the bucketed grouper, and the fold-in padder,
+# whose EFFECTIVE max_len cap must match training exactly)
+PAD_MULTIPLE = 8
+
+
 def dedup_sum_ratings(rows: np.ndarray, cols: np.ndarray,
                       values: np.ndarray, n_cols: int):
     """Sum duplicate (row, col) pairs — the template's
@@ -156,7 +162,7 @@ def dedup_sum_sorted(key: np.ndarray, rows: np.ndarray, cols: np.ndarray,
 
 def pad_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
                 n_rows: int, n_cols: int,
-                pad_multiple: int = 8,
+                pad_multiple: int = PAD_MULTIPLE,
                 max_len: Optional[int] = None) -> PaddedRatings:
     """CSR-style host-side padding of rating triples for one solve side.
 
@@ -231,7 +237,7 @@ _pad_rows = pad_rows_to_block  # private alias kept for older callers
 
 
 def transpose_ratings(pr: PaddedRatings, rows: np.ndarray, cols: np.ndarray,
-                      values: np.ndarray, pad_multiple: int = 8,
+                      values: np.ndarray, pad_multiple: int = PAD_MULTIPLE,
                       max_len: Optional[int] = None) -> PaddedRatings:
     """The other solve side: pad by column."""
     return pad_ratings(cols, rows, values, pr.n_cols, pr.n_rows,
@@ -332,7 +338,7 @@ def bucket_ratings(rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
                    n_rows: int, n_cols: int,
                    bucket_lengths: Optional[Sequence[int]] = None,
                    max_len: Optional[int] = None,
-                   pad_multiple: int = 8,
+                   pad_multiple: int = PAD_MULTIPLE,
                    row_multiple: int = 8) -> BucketedRatings:
     """Group rows by rating-count into geometric length buckets.
 
@@ -354,7 +360,7 @@ def bucket_ratings_pair(
         rows: np.ndarray, cols: np.ndarray, values: np.ndarray,
         n_rows: int, n_cols: int,
         bucket_lengths: Optional[Sequence[int]] = None,
-        max_len: Optional[int] = None, pad_multiple: int = 8,
+        max_len: Optional[int] = None, pad_multiple: int = PAD_MULTIPLE,
         row_multiple: int = 8) -> Tuple[BucketedRatings, BucketedRatings]:
     """Both solve sides from one pass: dedup-sum once, bucket the row
     side from the (already row-grouped) result, and the column side
@@ -1178,6 +1184,138 @@ def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
     # host factors always land fp32 (see train_als_bucketed)
     return (np.asarray(X, dtype=np.float32)[:n_u],
             np.asarray(Y, dtype=np.float32)[:n_i])
+
+
+# ---------------------------------------------------------------------------
+# Online fold-in (ROADMAP item 3): the normal-equations half-step reused at
+# batch size 1..k against FIXED item factors, so a deployed server can solve
+# fresh user rows seconds after their events arrive — no retrain, no reload.
+# ---------------------------------------------------------------------------
+
+_fold_in_jit = None
+
+
+def _get_fold_in_jit():
+    """Jitted batch-k fold-in solve — exactly :func:`_solve_rows` (the
+    training half-step) with the item side held fixed. ``solver`` /
+    ``precision`` / the scalar hyperparameters are static, so each
+    (B, L, R, statics) signature compiles once and every later fold at
+    the same bucketed shape reuses the executable."""
+    global _fold_in_jit
+    if _fold_in_jit is None:
+        import jax
+
+        def impl(Y, cols, weights, mask, *, lam, alpha, implicit,
+                 solver, precision, refine):
+            return _solve_rows(Y, cols, weights, mask, lam, alpha,
+                               implicit, None, solver, precision, refine)
+
+        _fold_in_jit = jax.jit(
+            impl, static_argnames=("lam", "alpha", "implicit", "solver",
+                                   "precision", "refine"))
+    return _fold_in_jit
+
+
+def pad_fold_in_batch(cols_list: Sequence[np.ndarray],
+                      vals_list: Sequence[np.ndarray],
+                      row_bucket: int = 8, len_bucket: int = 8,
+                      max_len: Optional[int] = None):
+    """Pad k ragged per-user rating sets into one ``[B, L]`` solve table.
+
+    Both dimensions round up the power-of-two ladder (``B`` from
+    ``row_bucket``, ``L`` from ``len_bucket``) so a long-lived server's
+    repeated folds hit a handful of compiled programs instead of one
+    per distinct (k, longest-row) pair. Duplicate (user, item) pairs
+    are summed first — the same ``reduceByKey`` aggregation training
+    applies (:func:`dedup_sum_ratings`). ``max_len`` applies the SAME
+    per-row truncation training applies (:func:`pad_ratings`: keep the
+    largest-magnitude ratings) — an engine trained with truncation must
+    fold truncated, or the fold solves a different objective than the
+    trained rows for exactly the long-history users the cap exists for
+    (it also bounds the ``L`` bucket, so one pathological user cannot
+    force a giant fresh compile inside the live server). Padding
+    rows/slots carry a zero mask, so they solve to exact zero rows and
+    slice off."""
+    # lazy: serving imports from this module the same way
+    from predictionio_tpu.ops.serving import bucket_size
+
+    k = len(cols_list)
+    # the EFFECTIVE training cap: pad_ratings/_bucket_grouped round
+    # max_len up to PAD_MULTIPLE and only cut rows beyond that —
+    # truncating at the raw max_len here would solve a smaller problem
+    # than training did for rows in the rounding gap
+    cap = None if max_len is None else max(
+        1, -(-int(max_len) // PAD_MULTIPLE) * PAD_MULTIPLE)
+    deduped = []
+    longest = 1
+    for c, v in zip(cols_list, vals_list):
+        c = np.asarray(c, dtype=np.int64)
+        v = np.asarray(v, dtype=np.float32)
+        if len(c):
+            order = np.argsort(c, kind="stable")
+            _, cc, vv = dedup_sum_sorted(c[order], c[order], c[order],
+                                         v[order])
+            if cap is not None and len(cc) > cap:
+                sel = np.argsort(-np.abs(vv), kind="stable")[:cap]
+                cc, vv = cc[sel], vv[sel]
+            deduped.append((cc, vv))
+            longest = max(longest, len(cc))
+        else:
+            deduped.append((c, v))
+    B = bucket_size(max(k, 1), row_bucket)
+    L = bucket_size(longest, len_bucket)
+    cols = np.zeros((B, L), dtype=np.int32)
+    weights = np.zeros((B, L), dtype=np.float32)
+    mask = np.zeros((B, L), dtype=np.float32)
+    for i, (c, v) in enumerate(deduped):
+        m = len(c)
+        cols[i, :m] = c
+        weights[i, :m] = v
+        mask[i, :m] = 1.0
+    return cols, weights, mask
+
+
+def fold_in_users(item_factors, cols_list: Sequence[np.ndarray],
+                  vals_list: Sequence[np.ndarray],
+                  params: ALSParams,
+                  max_len: Optional[int] = None) -> np.ndarray:
+    """Solve ``k`` user rows against FIXED item factors (the ALX
+    normal-equations machinery at batch size 1..k — ROADMAP item 3).
+
+    ``cols_list[i]`` / ``vals_list[i]`` are user ``i``'s FULL rating set
+    (item indices + values, duplicates summed here); the returned
+    ``[k, R]`` float32 rows are exactly what one training half-step
+    (:func:`_solve_rows`) would produce for those users given these
+    item factors — the differential contract the fold-in suite gates.
+
+    The precision policy is the training one (``ALSParams.precision`` /
+    ``PIO_ALS_PRECISION``, resolved per call): under ``bf16`` the item
+    factors are gathered bfloat16 with fp32 accumulation and solve,
+    matching ``train_als``'s storage/compute split. ``item_factors``
+    may be host numpy or a live device array (e.g. the serving store's
+    HBM-resident ``Y``, possibly already bf16)."""
+    import jax.numpy as jnp
+
+    precision = _als_precision_mode(params)
+    Y = jnp.asarray(item_factors)
+    want = factor_dtype(precision)
+    if Y.dtype != want:
+        # cast through fp32 so a bf16 serving store folds identically
+        # under an fp32 training policy (and vice versa)
+        Y = Y.astype(jnp.float32).astype(want) if want != jnp.float32 \
+            else Y.astype(jnp.float32)
+    k = len(cols_list)
+    if k == 0:
+        return np.zeros((0, Y.shape[1]), dtype=np.float32)
+    cols, weights, mask = pad_fold_in_batch(cols_list, vals_list,
+                                            max_len=max_len)
+    out = _get_fold_in_jit()(
+        Y, cols, weights, mask,
+        lam=float(params.lambda_), alpha=float(params.alpha),
+        implicit=bool(params.implicit_prefs),
+        solver=_spd_solver_mode(), precision=precision,
+        refine=bool(params.solve_refine))
+    return np.asarray(out[:k], dtype=np.float32)
 
 
 # ---------------------------------------------------------------------------

@@ -67,6 +67,18 @@ def _is_bf16(arr) -> bool:
     return getattr(getattr(arr, "dtype", None), "name", "") == "bfloat16"
 
 
+def foldin_enabled() -> bool:
+    """``PIO_FOLDIN`` — set by ``pio deploy --foldin on`` (and readable
+    directly by embedders): the deployed server runs the online fold-in
+    consumer, which needs an UPDATABLE device factor store. Like the
+    bf16 rule, it forces the device backend in auto mode and conflicts
+    loudly with an explicit host backend."""
+    import os
+
+    return os.environ.get("PIO_FOLDIN", "").strip().lower() in (
+        "1", "on", "true", "yes")
+
+
 def _score_einsum(subscripts: str, *operands):
     """Scoring matmul under the serving precision policy: fp32 factors
     keep the historical full-precision MXU passes; bf16 factors feed the
@@ -299,12 +311,19 @@ def choose_server(user_factors, item_factors,
     auto mode — the policy is an HBM policy and means nothing on host —
     and conflicts loudly with an explicit ``host`` backend.
 
+    ``PIO_FOLDIN`` (set by ``pio deploy --foldin on``) likewise forces
+    the device backend: online fold-in patches the live factor store in
+    place (:meth:`DeviceTopK.patch_users`), which HostTopK does not
+    support — the host+foldin combination raises loudly (mirror of the
+    bf16 rule).
+
     Device-resident (sharded) models never go through this — their
     factors live only in HBM and always serve via DeviceTopK."""
     import os
 
     backend = os.environ.get("PIO_SERVING_BACKEND", "auto").lower()
     bf16_serve = _serve_precision_mode() == "bf16"
+    foldin = foldin_enabled()
     host_capable = not (hasattr(user_factors, "sharding")
                         or hasattr(item_factors, "sharding"))
     if backend == "host":
@@ -317,8 +336,14 @@ def choose_server(user_factors, item_factors,
                 "PIO_SERVE_PRECISION=bf16 conflicts with "
                 "PIO_SERVING_BACKEND=host: the bf16 store is a device "
                 "(HBM) policy; host serving is always fp32")
+        if foldin:
+            raise ValueError(
+                "PIO_FOLDIN=on conflicts with PIO_SERVING_BACKEND=host: "
+                "online fold-in patches the DEVICE factor store in place "
+                "(DeviceTopK.patch_users); host serving has no updatable "
+                "store")
         cls = HostTopK
-    elif backend == "device" or bf16_serve:
+    elif backend == "device" or bf16_serve or foldin:
         cls = DeviceTopK
     else:
         small = (np.asarray(item_factors).size <= HOST_SERVE_MAX_ELEMS
@@ -563,6 +588,54 @@ class _ItemBatcher(_MicroBatcher):
         self._scatter_results(group, idx, scores)
 
 
+_scatter_jits: Dict[bool, object] = {}
+
+
+def _scatter_rows(table, idx, rows):
+    """Jitted row scatter for live-store patches: ``table.at[idx].set``
+    with the rows cast to the store dtype. On accelerators the input
+    table is DONATED — the scatter reuses the store's own HBM instead
+    of copying it (the PR-5 donation discipline applied to serving);
+    the XLA runtime serializes the aliasing against any in-flight
+    reader of the same buffer. CPU has no donation path, so there the
+    program is a plain copy (and jax would warn on every patch)."""
+    import jax
+
+    donate = jax.default_backend() != "cpu"
+    fn = _scatter_jits.get(donate)
+    if fn is None:
+        fn = jax.jit(lambda t, i, r: t.at[i].set(r.astype(t.dtype)),
+                     donate_argnums=(0,) if donate else ())
+        _scatter_jits[donate] = fn
+    import jax.numpy as jnp
+
+    return fn(table, jnp.asarray(idx), jnp.asarray(rows))
+
+
+_seen_scatter_jits: Dict[bool, object] = {}
+
+
+def _scatter_seen(cols, mask, idx, row_c, row_m):
+    """Both seen tables scattered in ONE dispatch (donating both on
+    accelerators): a caller replacing live store references must not
+    be able to land the cols update and then fail the mask update —
+    one program means the pair succeeds or fails together."""
+    import jax
+
+    donate = jax.default_backend() != "cpu"
+    fn = _seen_scatter_jits.get(donate)
+    if fn is None:
+        fn = jax.jit(
+            lambda c, m, i, rc, rm: (c.at[i].set(rc.astype(c.dtype)),
+                                     m.at[i].set(rm.astype(m.dtype))),
+            donate_argnums=(0, 1) if donate else ())
+        _seen_scatter_jits[donate] = fn
+    import jax.numpy as jnp
+
+    return fn(cols, mask, jnp.asarray(idx), jnp.asarray(row_c),
+              jnp.asarray(row_m))
+
+
 class DeviceTopK:
     """AOT-compiled top-N server over device-resident (optionally
     sharded) factor matrices.
@@ -574,6 +647,12 @@ class DeviceTopK:
     Concurrent ``user_topk`` callers are micro-batched into one device
     dispatch (see :class:`_MicroBatcher`); set ``microbatch=False`` or
     ``PIO_SERVING_MICROBATCH=0`` to dispatch per call.
+
+    The user factor store is LIVE-PATCHABLE (:meth:`patch_users`, the
+    online fold-in write path): every device dispatch snapshots the
+    store references under ``_store_lock``, and a patch swaps all of
+    them under the same lock — an in-flight micro-batch therefore sees
+    either the whole old store or the whole new one, never a torn mix.
     """
 
     ITEM_QUERY_BUCKET = 8  # padded query-item count for similarity queries
@@ -587,6 +666,7 @@ class DeviceTopK:
 
         import jax.numpy as jnp
 
+        self._store_lock = threading.RLock()
         if microbatch is None:
             microbatch = os.environ.get(
                 "PIO_SERVING_MICROBATCH",
@@ -744,9 +824,10 @@ class DeviceTopK:
         bucket and the result is clipped, so arbitrary nums reuse
         programs; the uid rides inside the async jit dispatch."""
         kb = min(_bucket(k), self.n_items)
-        out = self._user_program(kb)(
-            self._X, self._Y, self._seen_cols, self._seen_mask,
-            np.int32(uid))
+        with self._store_lock:
+            out = self._user_program(kb)(
+                self._X, self._Y, self._seen_cols, self._seen_mask,
+                np.int32(uid))
         idx, scores = _unpack(np.asarray(out), kb)
         idx, scores = idx[:k], scores[:k]
         valid = np.isfinite(scores)
@@ -770,8 +851,10 @@ class DeviceTopK:
             padded = np.zeros(bb, dtype=np.int32)
             padded[:n] = uids
             kb = min(_bucket(k), self.n_items)
-            out = self._batch_program(kb, bb)(
-                self._X, self._Y, self._seen_cols, self._seen_mask, padded)
+            with self._store_lock:
+                out = self._batch_program(kb, bb)(
+                    self._X, self._Y, self._seen_cols, self._seen_mask,
+                    padded)
             idx, scores = _unpack(np.asarray(out), kb)
             return idx[:n, :k], scores[:n, :k]
 
@@ -819,7 +902,144 @@ class DeviceTopK:
                 partial(_items_topk, k=kb, n_items=self.n_items),
                 in_axes=(None, 0, 0)))
             self._item_programs[(kb, B, G)] = prog
-        out = prog(self._normalized_items(), jnp.asarray(idxs),
-                   jnp.asarray(masks))
+        with self._store_lock:
+            out = prog(self._normalized_items(), jnp.asarray(idxs),
+                       jnp.asarray(masks))
         idx, scores = _unpack(np.asarray(out), kb)
         return idx, scores
+
+    # -- live store patching (online fold-in) ------------------------------
+
+    @property
+    def item_factors(self):
+        """The item-side factor store as served (possibly bf16, possibly
+        sharded) — what the fold-in solve must hold fixed."""
+        return self._Y
+
+    @property
+    def user_capacity(self) -> int:
+        """Allocated user rows (>= ``n_users``; grows by bucket ladder)."""
+        return int(self._X.shape[0])
+
+    @property
+    def growable(self) -> bool:
+        """Whether :meth:`patch_users` can grow the user store. False
+        for mesh-sharded stores — those grow at retrain only, so a
+        fold-in deployment must refuse them up front rather than poison
+        every fold batch with the first unknown user."""
+        sh = getattr(self._X, "sharding", None)
+        return not (sh is not None and getattr(
+            getattr(sh, "mesh", None), "devices", np.empty(1)).size > 1)
+
+    def patch_users(self, uids, factors,
+                    seen_items: Optional[Dict[int, np.ndarray]] = None
+                    ) -> None:
+        """Scatter freshly solved user rows into the LIVE factor store —
+        the online fold-in write path (no ``/reload``, no retrain).
+
+        ``uids`` may index PAST the current capacity: the store grows
+        along the power-of-two bucket ladder (new rows zero until
+        patched), so a stream of brand-new users costs O(log growth)
+        reallocations, and the compiled top-k programs re-specialize at
+        the same cadence. ``factors`` rows are cast to the store dtype
+        (fp32 or the bf16 serving policy). ``seen_items`` replaces the
+        touched users' on-device seen-masking rows with their full item
+        sets (ignored when the server was built without seen masking).
+
+        Atomicity contract: every store reference is swapped under the
+        same ``_store_lock`` each device dispatch snapshots under, so a
+        concurrent query sees either the whole old store or the whole
+        new one — never a torn mix. On accelerators the scatter donates
+        the old buffer (in-place HBM update, the PR-5 donation
+        discipline); growth, when a sharded store would need it, is
+        refused loudly — sharded models grow at retrain time.
+        """
+        import jax.numpy as jnp
+
+        uids = np.asarray(uids, dtype=np.int64)
+        factors = np.asarray(factors, dtype=np.float32)
+        if factors.ndim != 2 or len(uids) != factors.shape[0]:
+            raise ValueError(
+                f"patch_users: {len(uids)} uids vs factors "
+                f"{factors.shape}")
+        if not len(uids):
+            return
+        if uids.min() < 0:
+            raise ValueError("patch_users: negative user index")
+        with self._store_lock:
+            # phase 1 — everything that can FAIL, with no live buffer
+            # donated yet: growth builds new arrays (the old store stays
+            # whole), seen prep is pads + host loops. Only after all of
+            # it succeeds does phase 2 donate, and each donating call is
+            # paired with its publish in the same statement — an
+            # exception can therefore never strand self._X (or the seen
+            # tables) pointing at an already-donated, deleted buffer.
+            X = self._X
+            needed = int(uids.max()) + 1
+            cap = X.shape[0]
+            if needed > cap:
+                if not self.growable:
+                    raise ValueError(
+                        "patch_users: cannot grow a mesh-sharded factor "
+                        "store in place; unknown users on sharded models "
+                        "need a retrain")
+                new_cap = _bucket(needed, lo=max(cap, 16))
+                X = jnp.concatenate(
+                    [X, jnp.zeros((new_cap - cap, X.shape[1]), X.dtype)])
+            seen_prep = None
+            if self._mask_seen and (
+                    seen_items or X.shape[0] > self._seen_cols.shape[0]):
+                # even a seen-less patch must grow the tables alongside
+                # X: a new uid whose seen row does not exist would
+                # CLAMP into the last existing user's row at gather
+                # time — silently masking the new user's top-k with an
+                # arbitrary other user's seen set. Grown rows are
+                # zero-masked ("nothing seen") until patched.
+                seen_prep = self._prep_seen_locked(
+                    seen_items or {}, int(X.shape[0]))
+            # phase 2 — donate + publish. Dispatch paths snapshot all
+            # four references under this same lock, so the intermediate
+            # states below are invisible to queries. Seen tables land
+            # FIRST: if the X scatter then fails, the store holds old
+            # factors with (possibly larger) seen tables — harmless for
+            # every reachable uid, whereas new-X-with-short-seen would
+            # let a grown uid clamp into another user's seen row.
+            if seen_prep is not None:
+                cols, mask, sids, row_c, row_m = seen_prep
+                self._seen_cols, self._seen_mask = _scatter_seen(
+                    cols, mask, sids, row_c, row_m)
+            self._X = _scatter_rows(X, uids, factors)
+            self.n_users = max(self.n_users, needed)
+
+    def _prep_seen_locked(self, seen_items: Dict[int, np.ndarray],
+                          n_rows: int):
+        """Seen tables grown (rows and row length, same bucket ladder as
+        the factors) plus the touched users' replacement rows — the
+        fallible half of a seen patch; the caller feeds it to the
+        donating :func:`_scatter_seen`. The pads COPY, so the live
+        tables are untouched if anything here raises. Caller holds
+        ``_store_lock``."""
+        import jax.numpy as jnp
+
+        cols, mask = self._seen_cols, self._seen_mask
+        L = int(cols.shape[1])
+        longest = max((len(v) for v in seen_items.values()), default=0)
+        new_L = _bucket(max(longest, 1), lo=L)
+        if new_L > L:
+            pad = new_L - L
+            cols = jnp.pad(cols, ((0, 0), (0, pad)))
+            mask = jnp.pad(mask, ((0, 0), (0, pad)))
+        rows = int(cols.shape[0])
+        if n_rows > rows:
+            cols = jnp.pad(cols, ((0, n_rows - rows), (0, 0)))
+            mask = jnp.pad(mask, ((0, n_rows - rows), (0, 0)))
+        sids = np.fromiter(seen_items.keys(), dtype=np.int64,
+                           count=len(seen_items))
+        row_c = np.zeros((len(sids), new_L), dtype=np.int32)
+        row_m = np.zeros((len(sids), new_L), dtype=np.float32)
+        for i, uid in enumerate(sids):
+            items = np.asarray(seen_items[int(uid)], dtype=np.int32)
+            m = min(len(items), new_L)
+            row_c[i, :m] = items[:m]
+            row_m[i, :m] = 1.0
+        return cols, mask, sids, row_c, row_m

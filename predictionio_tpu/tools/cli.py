@@ -226,6 +226,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="server.json with ssl cert/key for HTTPS "
                           "serving (default: $PIO_SERVER_CONFIG or "
                           "./server.json)")
+    dep.add_argument("--foldin", choices=("on", "off"), default="off",
+                     help="online fold-in: a background consumer tails "
+                          "the event stream and patches fresh user "
+                          "factors into the live device store — new "
+                          "users servable in seconds, no /reload, no "
+                          "retrain (forces the DeviceTopK backend; "
+                          "cadence via PIO_FOLDIN_INTERVAL / "
+                          "PIO_FOLDIN_COUNT)")
     _add_metrics_arg(dep)
     _add_tracing_args(dep)
     _add_serve_precision_arg(dep)

@@ -213,6 +213,11 @@ def cmd_deploy(args) -> int:
     _apply_metrics_flag(args)
     _apply_tracing_flags(args)
     _apply_precision_flags(args)
+    foldin = getattr(args, "foldin", "off") == "on"
+    # no env write here: QueryServer.deploy() sets PIO_FOLDIN from
+    # ServerConfig(foldin=True) before the model loads, and setting it
+    # earlier would make deploy() capture "1" as the prior value —
+    # defeating its own restore on stop()/failed deploy
     if args.feedback and not args.accesskey:
         # CreateServer.scala:452-455: feedback requires an access key
         print("[ERROR] Feedback loop cannot be enabled because accessKey "
@@ -236,6 +241,7 @@ def cmd_deploy(args) -> int:
         event_server_port=args.event_server_port,
         access_key=args.accesskey,
         server_config_path=getattr(args, "server_config", None),
+        foldin=foldin,
     )
     try:
         server = QueryServer(config).start()

@@ -572,6 +572,38 @@ BATCHPREDICT_QPS = REGISTRY.gauge(
     "pio_batchpredict_queries_per_sec",
     "Scoring throughput of the most recent batch-prediction run")
 
+# -- online fold-in (PR 8) -------------------------------------------------
+# event-ingested -> reflected-in-top-k can legitimately span the fold
+# cadence (seconds), which the default latency bounds would collapse
+# into +Inf
+FRESHNESS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0,
+                     60.0)
+FOLDIN_FOLDS = REGISTRY.counter(
+    "pio_foldin_folds_total",
+    "Online fold-in batches by outcome (ok / error / dropped)",
+    ("status",))
+FOLDIN_TAIL_ERRORS = REGISTRY.counter(
+    "pio_foldin_tail_errors_total",
+    "Failed tail reads (one per failing poll; pio_foldin_stale holds 1 "
+    "for the duration of the outage)", ())
+FOLDIN_USERS = REGISTRY.counter(
+    "pio_foldin_users_total",
+    "User rows patched into the live factor store by the fold-in "
+    "consumer (known = re-solved existing rows; new = store grown)",
+    ("kind",))
+FOLDIN_EVENTS = REGISTRY.counter(
+    "pio_foldin_events_total",
+    "Rating events consumed from the tail read and folded", ())
+FOLDIN_FRESHNESS = REGISTRY.histogram(
+    "pio_foldin_freshness_seconds",
+    "Event ingested -> factors servable latency per folded event",
+    buckets=FRESHNESS_BUCKETS)
+FOLDIN_STALE = REGISTRY.gauge(
+    "pio_foldin_stale",
+    "1 while the fold-in tail read is failing (serving continues from "
+    "the last-good factors, responses carry degradedReasons "
+    "foldin_stale)", ())
+
 # -- training workflow -----------------------------------------------------
 TRAIN_STAGE_LATENCY = REGISTRY.histogram(
     "pio_train_stage_seconds",

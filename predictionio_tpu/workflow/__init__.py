@@ -14,6 +14,7 @@ from predictionio_tpu.workflow.core_workflow import (
 )
 from predictionio_tpu.workflow.create_server import (
     QueryServer,
+    ReloadDowngradeError,
     ServerConfig,
     create_server,
     undeploy,
@@ -25,6 +26,7 @@ from predictionio_tpu.workflow.create_workflow import (
 
 __all__ = [
     "QueryServer",
+    "ReloadDowngradeError",
     "ServerConfig",
     "WorkflowConfig",
     "create_server",

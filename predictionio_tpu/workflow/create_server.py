@@ -88,6 +88,18 @@ class ServerConfig:
     # checks $PIO_SERVER_CONFIG / ./server.json, and a file without an
     # "ssl" section serves plain HTTP
     server_config_path: Optional[str] = None
+    # online fold-in (`pio deploy --foldin on`): a background consumer
+    # tails the event stream and patches fresh user factors into the
+    # live device store — see predictionio_tpu/online/foldin.py.
+    # Cadence knobs: PIO_FOLDIN_INTERVAL / PIO_FOLDIN_COUNT.
+    foldin: bool = False
+
+
+class ReloadDowngradeError(RuntimeError):
+    """``POST /reload`` refused: the latest completed instance is OLDER
+    than the one deployed. With online fold-in live, an accidental
+    downgrade throws away every folded user — the operator must
+    undeploy/redeploy explicitly to roll back (rendered as HTTP 409)."""
 
 
 def engine_instance_to_engine_params(
@@ -424,6 +436,9 @@ class QueryServer:
         self.plugin_context = plugin_context or EngineServerPluginContext()
         self.ctx = ctx or workflow_context(mode="serving", batch=config.batch)
         self._deployment: Optional[_Deployment] = None
+        self._foldin = None  # online.foldin.FoldInConsumer when enabled
+        self._foldin_env_prior: Optional[str] = None
+        self._foldin_env_set = False
         self._swap_lock = threading.Lock()
         # per-SERVER latency (status page bookkeeping); every record also
         # feeds the process-wide per-variant registry histogram
@@ -443,10 +458,59 @@ class QueryServer:
     def deploy(self) -> "QueryServer":
         """Load + warm the engine (createServerActorWithEngine,
         CreateServer.scala:213-272)."""
-        instance = self._resolve_instance()
-        self._deployment = self._build_deployment(instance)
+        if self.config.foldin:
+            # before the model loads: choose_server must see the policy
+            # (fold-in needs the updatable DeviceTopK store) whether the
+            # caller came through `pio deploy --foldin on` or built
+            # ServerConfig(foldin=True) directly. The prior value is
+            # restored by stop() — an embedder's NEXT deployment in the
+            # same process must not inherit this one's policy
+            import os
+
+            if not self._foldin_env_set:
+                self._foldin_env_prior = os.environ.get("PIO_FOLDIN")
+                self._foldin_env_set = True
+            os.environ["PIO_FOLDIN"] = "1"
+        try:
+            instance = self._resolve_instance()
+            self._deployment = self._build_deployment(instance)
+            if self.config.foldin:
+                self._start_foldin()
+        except BaseException:
+            # a FAILED deploy must not leak the policy into the
+            # process (stop() only covers the success path)
+            self._restore_foldin_env()
+            raise
         logger.info("Engine instance %s deployed", instance.id)
         return self
+
+    def _restore_foldin_env(self) -> None:
+        if not self._foldin_env_set:
+            return
+        import os
+
+        if self._foldin_env_prior is None:
+            os.environ.pop("PIO_FOLDIN", None)
+        else:
+            os.environ["PIO_FOLDIN"] = self._foldin_env_prior
+        self._foldin_env_set = False
+
+    def _start_foldin(self, deployment=None) -> None:
+        """(Re)start the online fold-in consumer against ``deployment``
+        (default: the current one). The NEW consumer starts before the
+        old one stops — attach/start raising therefore leaves the old
+        consumer running untouched, which lets reload() validate the
+        candidate deployment's fold-in BEFORE committing the swap. The
+        brief overlap is harmless: the old consumer patches the old
+        model's store, which is about to be dropped."""
+        from predictionio_tpu.online.foldin import attach_foldin
+
+        dep = deployment if deployment is not None else self._deployment
+        assert dep is not None
+        new = attach_foldin(dep).start()
+        if self._foldin is not None:
+            self._foldin.stop()
+        self._foldin = new
 
     def _build_deployment(self, instance: EngineInstance) -> Deployment:
         dep = build_deployment(instance, self.ctx,
@@ -500,6 +564,12 @@ class QueryServer:
             # the scope instead of failing the query — the device
             # factor store still answers, and the response says so
             with resilience.degraded_scope() as degraded:
+                foldin = self._foldin
+                if foldin is not None and foldin.stale:
+                    # the fold-in tail is failing: answers come from
+                    # the last-good factors (PR-7 semantics — serve,
+                    # but say so)
+                    resilience.mark_degraded("foldin_stale")
                 prediction = self._predict(dep, query)
         except QueryRejectedError as e:
             # queue overload: fail FAST with the server's own pacing
@@ -620,10 +690,18 @@ class QueryServer:
         return result
 
     # -- reload / status ---------------------------------------------------
-    def reload(self) -> str:
+    def reload(self) -> Dict[str, Any]:
         """Hot-swap to the latest completed instance
-        (MasterActor ReloadServer, CreateServer.scala:352-378)."""
+        (MasterActor ReloadServer, CreateServer.scala:352-378).
+
+        Hardened for the fold-in era: the response names BOTH instance
+        ids (swapped-from/to — an operator must be able to tell a real
+        swap from a same-instance re-deploy), and a swap to an instance
+        OLDER than the one deployed is refused (409) — with online
+        fold-in live, a silent downgrade discards every user folded
+        since the newer train."""
         with self._swap_lock:
+            current = self._deployment
             instances = storage.get_metadata_engine_instances()
             latest = instances.get_latest_completed(
                 self.config.engine_id, self.config.engine_version,
@@ -631,13 +709,40 @@ class QueryServer:
             if latest is None:
                 raise StorageError("No valid engine instance found for "
                                    "reload")
-            self._deployment = self._build_deployment(latest)
-            return latest.id
+            if current is not None and latest.id != current.instance.id \
+                    and latest.start_time < current.instance.start_time:
+                raise ReloadDowngradeError(
+                    f"refusing to reload: latest completed instance "
+                    f"{latest.id} (started "
+                    f"{latest.start_time.isoformat()}) is OLDER than the "
+                    f"deployed {current.instance.id} (started "
+                    f"{current.instance.start_time.isoformat()}); "
+                    "undeploy and redeploy explicitly to downgrade")
+            candidate = self._build_deployment(latest)
+            if self.config.foldin:
+                # validate the candidate's fold-in BEFORE the swap: if
+                # the new deployment cannot be tailed (non-ALSParams
+                # algorithm, missing app_name), the reload fails with
+                # the OLD deployment and its consumer fully intact —
+                # never a live swap with fold-in silently dead
+                self._start_foldin(candidate)
+            self._deployment = candidate
+            return {
+                "engineInstanceId": latest.id,
+                "swappedFrom": None if current is None
+                else current.instance.id,
+                "swappedTo": latest.id,
+            }
 
     def status(self) -> Dict[str, Any]:
         dep = self._deployment
         summary = self.latency.summary()
+        # snapshot: a concurrent stop() nulls self._foldin between a
+        # check and a call (same pattern as the predict path)
+        consumer = self._foldin
+        foldin = consumer.stats() if consumer is not None else None
         return {
+            "foldin": foldin,
             "status": "alive",
             "engineInstanceId": dep.instance.id if dep else None,
             "engineFactory": dep.instance.engine_factory if dep else None,
@@ -732,6 +837,10 @@ class QueryServer:
         return str(host), int(port)
 
     def stop(self) -> None:
+        if self._foldin is not None:
+            self._foldin.stop()
+            self._foldin = None
+        self._restore_foldin_env()
         if self._httpd is not None:
             httpd, self._httpd = self._httpd, None
             httpd.shutdown()  # stops serve_forever, THEN close the socket
@@ -840,9 +949,12 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
                 else:
                     self._respond(status, payload)
             elif path == "/reload":
-                iid = srv.reload()
-                self._respond(200, {"message": "Reloading...",
-                                    "engineInstanceId": iid})
+                try:
+                    info = srv.reload()
+                except ReloadDowngradeError as e:
+                    self._respond(409, {"message": str(e)})
+                    return
+                self._respond(200, {"message": "Reloading...", **info})
             elif path == "/stop":
                 self._respond(200, {"message": "Shutting down."})
                 threading.Thread(target=srv.stop, daemon=True).start()
