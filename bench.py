@@ -671,6 +671,244 @@ def serving_bench(X: np.ndarray, Y: np.ndarray, n_queries: int = 300,
     }
 
 
+def serving_load_bench(n_users: int = 256, n_items: int = 128,
+                       rank: int = 8,
+                       levels: tuple = (100.0, 250.0, 500.0, 1000.0),
+                       duration_sec: float = 3.0, clients: int = 8,
+                       slo_p99_ms: float = 250.0,
+                       seed: int = 23) -> dict:
+    """Closed-loop HTTP load generator against a DEPLOYED query server
+    — the PR-10 continuous-batching acceptance bench (ROADMAP item 2:
+    sub-10ms p50 at sustained QPS; BENCH_r03's thread-per-request path
+    measured p50 ~150ms).
+
+    Sweeps offered QPS: each level runs ``clients`` keep-alive
+    HTTP/1.1 connections pacing POST /queries.json at the offered
+    aggregate rate (closed loop: a client never has more than one
+    request in flight, so overload shows up as achieved < offered
+    rather than an unbounded in-flight pile). Reports per level
+    p50/p99/achieved-QPS, and:
+
+    - ``max_sustainable_qps``: the highest offered level that achieved
+      >= 95% of its target with p99 under the SLO;
+    - ``jit_compiles_steady_state``: the PR-2 jit-compile monitor delta
+      across every timed level — the AOT bucket ladder means it MUST be
+      zero (asserted, not eyeballed);
+    - PR-4 trace-exemplar pinpointing: the ``pio_query_seconds``
+      histogram's exemplar trace + the slow-query log, so a regressed
+      percentile links straight to the trace that cost it;
+    - the dispatcher's ``batcher_stats`` (dispatch triggers, batch fill,
+      queue-depth percentiles) for the served lanes."""
+    import datetime as _dt
+    import http.client
+    import os
+    import threading
+
+    from predictionio_tpu.controller import ComputeContext, EngineParams
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import StorageConfig
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.ops import serving as serving_mod
+    from predictionio_tpu.ops.als import ALSParams
+    from predictionio_tpu.templates.recommendation import (
+        DataSourceParams,
+        engine_factory,
+    )
+    from predictionio_tpu.utils import metrics, tracing
+    from predictionio_tpu.workflow import (
+        QueryServer,
+        ServerConfig,
+        run_train,
+    )
+    from predictionio_tpu.workflow.create_workflow import (
+        WorkflowConfig,
+        new_engine_instance,
+    )
+
+    rng = np.random.default_rng(seed)
+    prior_backend = os.environ.get("PIO_SERVING_BACKEND")
+    # the point is the continuous-batching DEVICE path; auto would pick
+    # HostTopK for a model this small on CPU
+    os.environ["PIO_SERVING_BACKEND"] = "device"
+    srv = None
+    try:
+        storage_mod.reset(StorageConfig(
+            sources={"LOAD": {"type": "memory"}},
+            repositories={"METADATA": "LOAD", "EVENTDATA": "LOAD",
+                          "MODELDATA": "LOAD"}))
+        aid = storage_mod.get_metadata_apps().insert(App(0, "loadbench"))
+        le = storage_mod.get_levents()
+        le.init(aid)
+        t0_evt = _dt.datetime(2024, 1, 1, tzinfo=_dt.timezone.utc)
+        le.insert_batch([
+            Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{int(i)}",
+                  properties={"rating": float(rng.integers(3, 6))},
+                  event_time=t0_evt)
+            for u in range(n_users)
+            for i in rng.choice(n_items, size=6, replace=False)], aid)
+
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("",
+                                DataSourceParams(app_name="loadbench")),
+            algorithm_params_list=[
+                ("als", ALSParams(rank=rank, num_iterations=2,
+                                  seed=seed))])
+        cfg = WorkflowConfig(
+            engine_factory="predictionio_tpu.templates.recommendation"
+                           ":engine_factory")
+        iid = run_train(engine, params, new_engine_instance(cfg, params),
+                        ctx=ComputeContext())
+        assert iid is not None
+
+        metrics.install_jit_compile_listener()
+        t0 = time.perf_counter()
+        srv = QueryServer(ServerConfig(ip="127.0.0.1", port=0)).start(
+            undeploy_stale=False)
+        deploy_sec = time.perf_counter() - t0  # includes the AOT ladder
+        host, port = srv.address
+
+        bodies = [json.dumps({"user": f"u{u}", "num": 10}).encode("utf-8")
+                  for u in range(n_users)]
+
+        def run_level(offered_qps: float, seconds: float) -> dict:
+            interval = clients / offered_qps  # per-client pacing
+            stop_at = time.perf_counter() + seconds
+            samples: list = []
+            errors = [0]
+            lock = threading.Lock()
+
+            def client(cx: int) -> None:
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+                mine: list = []
+                mine_err = 0
+                i = cx
+                next_t = time.perf_counter() + interval * (cx / clients)
+                while True:
+                    now = time.perf_counter()
+                    if now >= stop_at:
+                        break
+                    if next_t > now:
+                        time.sleep(min(next_t - now, stop_at - now))
+                        if time.perf_counter() >= stop_at:
+                            break
+                    next_t += interval
+                    body = bodies[i % len(bodies)]
+                    i += clients
+                    t0 = time.perf_counter()
+                    try:
+                        conn.request(
+                            "POST", "/queries.json", body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        resp.read()
+                        if resp.status != 200:
+                            mine_err += 1
+                            continue
+                    except Exception:
+                        mine_err += 1
+                        try:
+                            conn.close()
+                        except Exception:
+                            pass
+                        conn = http.client.HTTPConnection(host, port,
+                                                          timeout=30)
+                        continue
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                conn.close()
+                with lock:
+                    samples.extend(mine)
+                    errors[0] += mine_err
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(clients)]
+            t_start = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t_start
+            a = np.asarray(samples) if samples else None
+            return {
+                "offered_qps": offered_qps,
+                "achieved_qps": round(len(samples) / wall, 1),
+                "queries": len(samples),
+                "errors": errors[0],
+                "p50_ms": None if a is None
+                else round(float(np.percentile(a, 50)), 3),
+                "p99_ms": None if a is None
+                else round(float(np.percentile(a, 99)), 3),
+            }
+
+        # warm lane (uncounted): first HTTP requests touch lazy paths
+        # (query extraction caches, feedback plumbing) that are not
+        # device compiles but should not pollute the timed levels
+        run_level(levels[0], min(1.0, duration_sec))
+        compiles0 = metrics.JIT_COMPILES.value()
+
+        sweep = [run_level(q, duration_sec) for q in levels]
+        jit_delta = metrics.JIT_COMPILES.value() - compiles0
+
+        sustainable = None
+        for lv in sweep:
+            ok = (lv["queries"] > 0
+                  and lv["achieved_qps"] >= 0.95 * lv["offered_qps"]
+                  and lv["p99_ms"] is not None
+                  and lv["p99_ms"] <= slo_p99_ms)
+            if ok and (sustainable is None
+                       or lv["offered_qps"] > sustainable["offered_qps"]):
+                sustainable = lv
+        base = sweep[0]
+
+        # PR-4 pinpointing: the latency histogram's exemplar trace and
+        # the slow-query log name the trace (and stage) a regressed
+        # percentile came from
+        ex = metrics.QUERY_LATENCY.child(
+            variant="engine.json").exemplar
+        slow = tracing.trace_buffer().slow_log(3)
+        lanes = [st for st in serving_mod.batcher_stats()
+                 if st["dispatches"] > 0]
+
+        return {
+            "clients": clients,
+            "duration_sec_per_level": duration_sec,
+            "deploy_warmup_sec": round(deploy_sec, 2),
+            "levels": sweep,
+            "max_sustainable_qps": None if sustainable is None
+            else sustainable["offered_qps"],
+            "p50_ms": base["p50_ms"],
+            "p99_ms": base["p99_ms"],
+            "jit_compiles_steady_state": int(jit_delta),
+            "zero_compile_steady_state": jit_delta == 0,
+            "slo_p99_ms": slo_p99_ms,
+            "bench_r03_thread_per_request_p50_ms": 150.0,
+            "speedup_p50_vs_r03": None if not base["p50_ms"]
+            else round(150.0 / base["p50_ms"], 1),
+            "gate_p50_sub10ms": bool(base["p50_ms"] is not None
+                                     and base["p50_ms"] < 10.0),
+            "latency_exemplar": None if ex is None
+            else {"traceId": ex[0], "seconds": round(ex[1], 4)},
+            "slow_queries": slow,
+            "batchers": lanes,
+            "note": ("closed-loop keep-alive HTTP sweep through the "
+                     "deadline-aware batching dispatcher; p50/p99 are "
+                     "the FIRST level's (lightest load); "
+                     "zero_compile_steady_state is the AOT-ladder "
+                     "acceptance gate"),
+        }
+    finally:
+        if srv is not None:
+            srv.stop()
+        if prior_backend is None:
+            os.environ.pop("PIO_SERVING_BACKEND", None)
+        else:
+            os.environ["PIO_SERVING_BACKEND"] = prior_backend
+        storage_mod.reset()
+
+
 def batchpredict_bench(n_users: int = 2048, n_items: int = 512,
                        rank: int = 16, chunk: int = 256,
                        loop_sample: int = 256) -> dict:
@@ -1537,6 +1775,12 @@ def main(smoke: bool = False) -> None:
                             **({"n_queries": 50, "batch": 32}
                                if smoke else {}))
 
+    # the continuous-batching query path end to end: closed-loop HTTP
+    # sweep, max-sustainable QPS, and the zero-compile steady-state gate
+    serving_load = serving_load_bench(
+        **({"n_users": 96, "n_items": 64, "levels": (50.0, 100.0),
+            "duration_sec": 1.0, "clients": 4} if smoke else {}))
+
     # fp32 vs bf16 precision lanes on the headline shape (the fp32 lane
     # stays the headline definition; this reports what bf16 buys)
     precision = als_precision_bench(
@@ -1594,6 +1838,7 @@ def main(smoke: bool = False) -> None:
             "quality_scale_truncation": quality_scale,
             "text_classification": text_quality,
             "serving": serving,
+            "serving_load": serving_load,
             "instrumentation_overhead": overhead,
             "tracing_overhead": tracing_overhead,
             "batchpredict": batchpredict,
@@ -1623,6 +1868,12 @@ def main(smoke: bool = False) -> None:
             precision["bf16_speedup_vs_fp32"],
         "serving_batched_qps":
             serving["batched"]["queries_per_sec"],
+        "serving_load_p50_ms": serving_load["p50_ms"],
+        "serving_load_p99_ms": serving_load["p99_ms"],
+        "serving_load_max_sustainable_qps":
+            serving_load["max_sustainable_qps"],
+        "serving_load_zero_compiles":
+            serving_load["zero_compile_steady_state"],
         "batchpredict_bulk_qps": batchpredict["bulk_queries_per_sec"],
         "batchpredict_speedup_vs_looped":
             batchpredict["speedup_vs_looped"],

@@ -973,11 +973,13 @@ _als_iterations_bucketed_jit = None
 # jax Compiled. Populated by warmup_train_als_bucketed (typically on a
 # background thread overlapping H2D transfers); consulted by
 # _als_iterations_bucketed so the warmed first train skips its compile
-# wait entirely. Races are benign (worst case: one redundant compile).
-# Bounded FIFO: a long-lived process warming ever-new shapes must not
-# pin old executables (each holds device code).
-_aot_bucketed: dict = {}
+# wait entirely. The bounded-FIFO/best-effort machinery is the shared
+# ops/aot.py cache — the same pattern DeviceTopK's serve-time bucket
+# ladder precompiles through.
+from predictionio_tpu.ops.aot import AOTCache as _AOTCache
+
 _AOT_BUCKETED_MAX = 8
+_aot_bucketed = _AOTCache(_AOT_BUCKETED_MAX)
 
 
 def _bucketed_aot_key(args, kw) -> tuple:
@@ -1024,7 +1026,7 @@ def _als_iterations_bucketed(*args, **kw):
     matching AOT executable from :func:`warmup_train_als_bucketed`
     (statics baked at lower time) is used when present."""
     jitted = _get_bucketed_jit()
-    if _aot_bucketed:
+    if len(_aot_bucketed):
         compiled = _aot_bucketed.get(_bucketed_aot_key(args, kw))
         if compiled is not None:
             return compiled(*args)
@@ -1076,16 +1078,18 @@ def warmup_train_als_bucketed(user_side: BucketedRatings,
     window. Best-effort: returns False (and the normal jit path compiles
     as before) if this jax version's AOT path declines."""
     try:
+        from predictionio_tpu.ops import aot
+
         precision = _als_precision_mode(params)
         args, kw = _bucketed_call_args(user_side, item_side, params,
                                        precision, abstract=True)
         key = _bucketed_aot_key(args, kw)
         if key in _aot_bucketed:
             return True
-        compiled = _get_bucketed_jit().lower(*args, **kw).compile()
-        while len(_aot_bucketed) >= _AOT_BUCKETED_MAX:
-            _aot_bucketed.pop(next(iter(_aot_bucketed)))
-        _aot_bucketed[key] = compiled
+        compiled = aot.lower_compile(_get_bucketed_jit(), *args, **kw)
+        if compiled is None:
+            return False
+        _aot_bucketed.put(key, compiled)
         return True
     except Exception:
         return False

@@ -1,0 +1,83 @@
+"""Shared AOT-executable cache (the PR-6 ``_aot_bucketed`` pattern,
+extracted so training and serving warm the same way).
+
+``jax.jit`` compiles lazily: the FIRST call with a new abstract
+signature pays the XLA compile inline, on whatever thread happened to
+issue it — a training step, or worse, a live query. The AOT alternative
+is ``jitted.lower(*args).compile()``: trace + compile NOW, execute
+never, and keep the resulting ``jax.stages.Compiled`` for the hot path
+to call directly. Two consumers share this module:
+
+- ``ops/als.py`` warms the bucketed training program on a background
+  thread while the ingest pipeline's H2D transfers stream (PR 6);
+- ``ops/serving.py`` precompiles the query bucket LADDER at deploy so
+  no live query ever pays a serve-time compile (SURVEY hard part #4,
+  asserted by the jit-compile monitor in ``bench.serving_load_bench``).
+
+Both are best-effort: a cache miss (or a jax version whose AOT path
+declines) falls back to the plain jit wrapper, which compiles as
+before — correctness never depends on the cache, only latency does.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Hashable, Iterator, Optional
+
+
+class AOTCache:
+    """Bounded, thread-safe FIFO of AOT-compiled executables.
+
+    Bounded because each entry pins device code: a long-lived process
+    warming ever-new shapes must not accumulate executables forever
+    (the PR-6 rationale). Races on ``put`` are benign — worst case one
+    redundant compile wins the slot.
+    """
+
+    def __init__(self, max_entries: int = 8):
+        self._max = int(max_entries)
+        self._lock = threading.Lock()
+        self._entries: Dict[Hashable, Any] = {}
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        with self._lock:
+            return self._entries.get(key)
+
+    def put(self, key: Hashable, compiled: Any) -> None:
+        with self._lock:
+            if key in self._entries:
+                return
+            while len(self._entries) >= self._max:
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[key] = compiled
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: Hashable) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def keys(self) -> Iterator[Hashable]:
+        with self._lock:
+            return iter(tuple(self._entries))
+
+
+def lower_compile(jitted, *args, **kwargs) -> Optional[Any]:
+    """``jitted.lower(*args, **kwargs).compile()``, best-effort.
+
+    ``args`` may mix concrete arrays (their shape/dtype/sharding is
+    baked into the executable — pass the REAL factor stores so a
+    sharded model compiles for its own mesh) and
+    ``jax.ShapeDtypeStruct`` placeholders for per-call inputs. Returns
+    ``None`` when this jax version's AOT path declines; callers keep
+    the plain jit wrapper as the fallback."""
+    try:
+        return jitted.lower(*args, **kwargs).compile()
+    except Exception:
+        return None

@@ -35,31 +35,61 @@ trip here is pure regression and is treated as such):
 
 from __future__ import annotations
 
+import bisect
+import collections
+import itertools
 import threading
+import time
+import weakref
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FuturesTimeout
 from functools import partial
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from predictionio_tpu.ops.aot import AOTCache, lower_compile
 from predictionio_tpu.utils.tracing import span as _trace_span
 
 
-def _serve_precision_mode() -> str:
-    """Serving factor-store precision: ``fp32`` (default) or ``bf16``
-    (item/user factor matrices held in HBM as bfloat16 — half the
-    scoring HBM stream; every scoring matmul still accumulates fp32 via
-    ``preferred_element_type``, so returned scores stay float32).
-    ``PIO_SERVE_PRECISION`` opts in; unknown values raise (one shared
-    whitelist with the training-side ``PIO_ALS_PRECISION`` policy).
-    Resolved at server construction."""
+def _serve_precision_explicit() -> Optional[str]:
+    """The operator's explicit ``PIO_SERVE_PRECISION`` choice, or None
+    when unset. Unknown values raise (one shared whitelist with the
+    training-side ``PIO_ALS_PRECISION`` policy)."""
     import os
 
     mode = os.environ.get("PIO_SERVE_PRECISION", "").strip().lower()
     if not mode:
-        return "fp32"
+        return None
     from predictionio_tpu.ops.als import normalize_precision
 
     return normalize_precision(mode, "PIO_SERVE_PRECISION")
+
+
+def _default_serve_precision() -> str:
+    """The DEVICE factor store defaults to bfloat16 on accelerators
+    (the ALX storage/compute split as the serving default: half the HBM
+    the model pins AND half the bytes every scoring matmul streams,
+    with scores still accumulated fp32 — quality-gated by the PR-5
+    Precision@10 check). CPU keeps fp32: there is no native bf16
+    datapath there, so the cast costs latency and buys nothing."""
+    try:
+        import jax
+
+        return "bf16" if jax.default_backend() != "cpu" else "fp32"
+    except Exception:  # pragma: no cover - jax must exist to serve
+        return "fp32"
+
+
+def _serve_precision_mode() -> str:
+    """Serving factor-store precision as resolved at server
+    construction: the explicit ``PIO_SERVE_PRECISION`` (``fp32`` is the
+    opt-out, ``bf16`` forces the device backend), else the
+    backend-aware default (bf16 on accelerators, fp32 on CPU). The
+    host serving lane is unaffected either way — HostTopK always
+    scores fp32."""
+    explicit = _serve_precision_explicit()
+    return explicit if explicit is not None else _default_serve_precision()
 
 
 def _is_bf16(arr) -> bool:
@@ -306,10 +336,14 @@ def choose_server(user_factors, item_factors,
       enough that a numpy matvec beats a device round trip
       (< HOST_SERVE_MAX_ELEMS item-factor elements); DeviceTopK otherwise.
 
-    ``PIO_SERVE_PRECISION=bf16`` opts the device store into bfloat16
-    factors (fp32 score accumulation); it forces the device backend in
-    auto mode — the policy is an HBM policy and means nothing on host —
-    and conflicts loudly with an explicit ``host`` backend.
+    Device stores default to bfloat16 factors on accelerators (fp32
+    score accumulation; ``PIO_SERVE_PRECISION=fp32`` opts out). An
+    EXPLICIT ``PIO_SERVE_PRECISION=bf16`` additionally forces the
+    device backend in auto mode — the policy is an HBM policy and
+    means nothing on host — and conflicts loudly with an explicit
+    ``host`` backend. The backend-aware default never steers backend
+    selection: small host-resident models still serve via HostTopK
+    (always fp32).
 
     ``PIO_FOLDIN`` (set by ``pio deploy --foldin on``) likewise forces
     the device backend: online fold-in patches the live factor store in
@@ -322,7 +356,9 @@ def choose_server(user_factors, item_factors,
     import os
 
     backend = os.environ.get("PIO_SERVING_BACKEND", "auto").lower()
-    bf16_serve = _serve_precision_mode() == "bf16"
+    # only the operator's EXPLICIT bf16 steers backend selection; the
+    # accelerator default applies silently once a device store exists
+    bf16_serve = _serve_precision_explicit() == "bf16"
     foldin = foldin_enabled()
     host_capable = not (hasattr(user_factors, "sharding")
                         or hasattr(item_factors, "sharding"))
@@ -375,217 +411,492 @@ def _queue_deadline() -> Optional[float]:
     return val if val > 0 else None
 
 
-class _PendingQuery:
-    __slots__ = ("uid", "k", "done", "result", "error")
+def _serve_aot_enabled() -> bool:
+    """``PIO_SERVE_AOT`` kill switch (default on): AOT-precompile the
+    serving bucket ladder at warm-up. Off, warm-up falls back to
+    compiling each ladder program by executing it once — slower warm-up,
+    same no-serve-time-compile contract."""
+    import os
 
-    def __init__(self, uid, k: int):
-        self.uid = uid        # user index, or an item-index tuple
+    return os.environ.get("PIO_SERVE_AOT", "1").strip().lower() \
+        not in ("0", "off", "false")
+
+
+def _batch_window() -> float:
+    """``PIO_BATCH_WINDOW`` — the batching BUDGET in seconds (default
+    2ms): how long the dispatcher may hold a lone query hoping more
+    arrive to share its device dispatch. 0 disables the hold (dispatch
+    as soon as the dispatcher is free, the pre-PR-10 behavior). At
+    light load the budget is the whole added latency (~2ms against a
+    multi-ms query); under load batches fill to ``max_batch`` long
+    before it expires and the window never binds."""
+    from predictionio_tpu.utils.resilience import _env_float
+
+    return max(0.0, _env_float("PIO_BATCH_WINDOW", 0.002))
+
+
+class _BatchResult:
+    """One batched dispatch's output, shared by every request in the
+    group. Per-request rendering (row slice, clip to the request's own
+    k, finite filter) happens in :meth:`render` on the WAITING thread —
+    the dispatcher's serial section ends at the device fetch, so a
+    hundred-query batch does not serialize a hundred numpy filters
+    behind one thread."""
+
+    __slots__ = ("idx", "scores")
+
+    def __init__(self, idx: np.ndarray, scores: np.ndarray):
+        self.idx = idx
+        self.scores = scores
+
+    def render(self, row: int, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        ri = self.idx[row, :k]
+        rs = self.scores[row, :k]
+        valid = np.isfinite(rs)
+        return ri[valid], rs[valid]
+
+
+class _Pending:
+    """One queued query: payload (uid, or item-index tuple), its k, its
+    batching deadline (arrival + window; the EDF sort key) and the
+    future the waiting thread blocks on."""
+
+    __slots__ = ("payload", "k", "deadline", "seq", "future")
+
+    def __init__(self, payload, k: int, deadline: float, seq: int):
+        self.payload = payload
         self.k = k
-        self.done = threading.Event()
-        self.result = None
-        self.error: Optional[BaseException] = None
+        self.deadline = deadline
+        self.seq = seq
+        self.future: Future = Future()
+
+    def __lt__(self, other: "_Pending") -> bool:
+        return (self.deadline, self.seq) < (other.deadline, other.seq)
 
 
-class _MicroBatcher:
-    """Cross-request micro-batching for device queries (round-4 verdict
-    weak #5: concurrent single-query REST clients each paid their own
-    device dispatch serially).
+class BatchLane:
+    """One query kind's lane inside the shared :class:`BatchDispatcher`
+    — its own EDF queue, batch cap and group-dispatch function, but the
+    SAME dispatcher thread and deadline policy as every other lane.
+    Exposes the submit/stats surface servers and benches use."""
 
-    Callers enqueue a request and block on a per-request event; one
-    dispatcher thread drains EVERYTHING pending into a single batched
-    dispatch (``_dispatch_group``, subclass-provided). No artificial
-    wait window: while a device dispatch is in flight, new arrivals
-    pile up and form the next batch — at low load a query pays one
-    dispatch exactly as before, under load throughput approaches the
-    batched-program rate instead of one transport round trip per query
-    (the live-server application of ``P2LAlgorithm.scala:66-68`` batch
-    semantics)."""
+    def __init__(self, dispatcher: "BatchDispatcher", name: str,
+                 max_batch: int,
+                 dispatch_fn: Callable[["DeviceTopK", List[_Pending]],
+                                       None]):
+        self._d = dispatcher
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.dispatch_fn = dispatch_fn
+        self.queue: List[_Pending] = []  # dispatcher-owned, EDF-sorted
+        # stats (written under the dispatcher's stats lock). `pending`
+        # counts queries WAITING anywhere — handoff deque or lane
+        # queue — so queue-depth observability covers the window while
+        # the dispatcher is blocked inside a device dispatch (the old
+        # cv-based batcher counted at submit; len(queue) alone would
+        # read 0 through exactly the overload the gauge exists to show)
+        self.pending = 0
+        self.dispatches = 0
+        self.batched_queries = 0
+        self.rejections = 0
+        self.triggers = {"size": 0, "window": 0, "drain": 0}
+        self.depth_samples: collections.deque = collections.deque(
+            maxlen=512)
 
-    name = "pio-microbatch"
+    def submit(self, payload, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Enqueue, block for the shared dispatch, render THIS request's
+        rows on the calling thread. Raises :class:`QueryRejectedError`
+        after the PR-7 queue deadline."""
+        k = int(k)
+        res, row = self._d.submit_wait(self, payload, k)
+        return res.render(row, k)
 
-    def __init__(self, server: "DeviceTopK", max_batch: int = 256):
-        import weakref
+    def submit_async(self, payload, k: int,
+                     window: Optional[float] = None) -> Future:
+        """Enqueue without blocking; the future resolves to
+        ``(_BatchResult, row)``. ``window`` overrides this query's
+        batching budget (the EDF deadline is arrival + window)."""
+        return self._d.enqueue(self, payload, int(k), window=window)
 
+    def stats(self) -> Dict[str, Any]:
+        """The unified ``batcher_stats`` shape (same keys for user and
+        item lanes): throughput counters, dispatch-trigger breakdown,
+        batch-fill ratio and queue-depth percentiles over the last 512
+        dispatches."""
+        with self._d._stats_lock:
+            depths = list(self.depth_samples)
+            st: Dict[str, Any] = {
+                "batcher": self.name,
+                "dispatches": self.dispatches,
+                "batchedQueries": self.batched_queries,
+                "queueDepth": self.pending,
+                "maxBatch": self.max_batch,
+                "windowSec": self._d.window,
+                "dispatchTriggers": dict(self.triggers),
+                "rejectedQueries": self.rejections,
+                "batchFillRatio": round(
+                    self.batched_queries
+                    / (self.dispatches * self.max_batch), 4)
+                if self.dispatches else 0.0,
+            }
+        if depths:
+            a = np.asarray(depths)
+            st["queueDepthPercentiles"] = {
+                "p50": float(np.percentile(a, 50)),
+                "p90": float(np.percentile(a, 90)),
+                "p99": float(np.percentile(a, 99)),
+                "max": int(a.max()),
+            }
+        else:
+            st["queueDepthPercentiles"] = None
+        return st
+
+
+class BatchDispatcher:
+    """Deadline-aware cross-request batching for device queries — the
+    PR-10 replacement for the condition-variable ``_MicroBatcher`` /
+    ``_ItemBatcher`` pair.
+
+    ONE dispatcher thread serves every lane. Callers hand off through a
+    deque plus an event wake; the only lock the submit path shares with
+    the dispatcher (``_thread_lock``, making the closed-check + append
+    atomic against ``close()``) is never held across a device dispatch
+    — submits never wait on device work. The thread moves arrivals into per-lane
+    queues kept sorted by DEADLINE (earliest-deadline-first; deadline =
+    arrival + ``PIO_BATCH_WINDOW``) and dispatches a lane when:
+
+    - ``size``:   the lane holds ``max_batch`` queries — a full batch
+                  amortizes one device dispatch over all of them;
+    - ``window``: the OLDEST query's batching budget expired — light
+      load pays at most the ~2ms window, never an unbounded wait;
+    - ``drain``:  the dispatcher is closing and flushes what is queued.
+
+    Results travel back through per-request futures; per-request
+    rendering runs on the waiting threads (:class:`_BatchResult`). The
+    PR-7 queue-deadline shedding is preserved: a query still queued
+    past ``PIO_QUERY_QUEUE_DEADLINE`` cancels its future and surfaces
+    as a 503 + Retry-After; one already drained into an in-flight
+    dispatch blocks for its imminent result instead."""
+
+    name = "pio-microbatch-dispatcher"
+
+    def __init__(self, server: "DeviceTopK",
+                 window: Optional[float] = None):
         # weakref: the dispatcher thread must not pin the server's
         # factor matrices alive after the owner drops it (model swap)
         self._srv_ref = weakref.ref(server)
-        self._max = max_batch
-        self._cv = threading.Condition()
-        self._pending: List[_PendingQuery] = []
-        self._thread: Optional[threading.Thread] = None
-        self._closed = False
-        # stats live behind self._cv: they are written by the dispatcher
-        # thread and read by servers/benches, and they survive dispatcher
-        # restarts — unlocked += here raced with those reads
-        self.dispatches = 0      # stats: device dispatches issued
-        self.batched_queries = 0  # stats: queries served through them
+        self.window = _batch_window() if window is None else float(window)
         # queue deadline resolved ONCE (env read off the submit path);
         # a server restart picks up a changed PIO_QUERY_QUEUE_DEADLINE
         self._deadline = _queue_deadline()
+        self._lanes: List[BatchLane] = []
+        self._handoff: collections.deque = collections.deque()
+        self._wake = threading.Event()
+        self._seq = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._closed = False
 
-    def stats(self) -> Dict[str, int]:
-        """Consistent stats snapshot (one lock acquisition)."""
-        with self._cv:
-            return {"dispatches": self.dispatches,
-                    "batchedQueries": self.batched_queries,
-                    "queueDepth": len(self._pending),
-                    "maxBatch": self._max}
+    def add_lane(self, name: str, max_batch: int,
+                 dispatch_fn) -> BatchLane:
+        lane = BatchLane(self, name, max_batch, dispatch_fn)
+        self._lanes.append(lane)
+        return lane
 
-    def _set_queue_gauge_locked(self) -> None:
-        from predictionio_tpu.utils import metrics
+    # -- submit side -------------------------------------------------------
 
-        metrics.MICROBATCH_QUEUE_DEPTH.set(len(self._pending),
-                                           batcher=self.name)
+    def enqueue(self, lane: BatchLane, payload, k: int,
+                window: Optional[float] = None) -> Future:
+        if self._closed:
+            raise RuntimeError("serving backend is closed")
+        w = self.window if window is None else float(window)
+        item = _Pending(payload, k, time.monotonic() + w,
+                        next(self._seq))
+        # pending is incremented BEFORE the item becomes visible in the
+        # handoff: the dispatcher's decrement (at pop, under the stats
+        # lock) can then never run before this increment, so the depth
+        # gauge/samples cannot go transiently negative — the worst
+        # inconsistency is a <=1 overcount for the instant an enqueue
+        # is in flight
+        with self._stats_lock:
+            lane.pending += 1
+        # the closed-check and the append are one atomic step against
+        # close(): once close() flips _closed under this lock, no item
+        # can slip into the handoff AFTER its final drain and strand an
+        # unresolved future. (The lock is never held across a device
+        # dispatch — the dispatcher takes it only for its brief
+        # idle-exit check — and appending before wake/ensure means the
+        # idle-exit emptiness re-check can never strand an item either.)
+        try:
+            with self._thread_lock:
+                if self._closed:
+                    raise RuntimeError("serving backend is closed")
+                self._handoff.append((lane, item))
+        except BaseException:
+            with self._stats_lock:
+                lane.pending -= 1
+            raise
+        self._set_queue_gauge(lane)
+        self._wake.set()
+        self._ensure_thread()
+        return item.future
 
-    def submit(self, uid, k: int):
-        item = _PendingQuery(uid, k)
-        with self._cv:
-            if self._closed:
-                raise RuntimeError("serving backend is closed")
-            if self._thread is None or not self._thread.is_alive():
-                # the dispatcher may have exited through the weakref-dead
-                # idle path (server briefly unreferenced) — a submit on a
-                # dead thread would otherwise block on item.done forever;
-                # restart it, the queue and stats survive
-                self._thread = threading.Thread(
-                    target=self._run, daemon=True, name=self.name)
-                self._thread.start()
-            self._pending.append(item)
-            self._set_queue_gauge_locked()
-            self._cv.notify()
+    def submit_wait(self, lane: BatchLane, payload,
+                    k: int) -> Tuple[_BatchResult, int]:
+        fut = self.enqueue(lane, payload, k)
         deadline = self._deadline
-        if not item.done.wait(deadline):
-            # still waiting past the deadline: if the item is STILL in
-            # the queue, yank it and fail fast — the client gets a 503
-            # + Retry-After instead of an unbounded wait. If it was
-            # already drained into an in-flight dispatch, the result is
-            # imminent (the dispatch owns it); block for it.
-            with self._cv:
-                if item in self._pending:
-                    self._pending.remove(item)
-                    self._set_queue_gauge_locked()
-                    rejected = True
-                else:
-                    rejected = False
-            if rejected:
+        try:
+            return fut.result(timeout=deadline)
+        except _FuturesTimeout:
+            # queued past the deadline: cancel-if-still-queued wins a
+            # fast 503; losing the race means the dispatcher already
+            # owns it and the result is imminent — block for it.
+            if fut.cancel():
+                with self._stats_lock:
+                    lane.rejections += 1
                 from predictionio_tpu.utils import metrics
 
-                metrics.MICROBATCH_REJECTIONS.inc(batcher=self.name)
+                metrics.MICROBATCH_REJECTIONS.inc(batcher=lane.name)
                 raise QueryRejectedError(
                     f"query queued past {deadline}s without a device "
                     "dispatch slot; retry shortly",
                     retry_after=min(5.0, max(1.0, deadline / 4)))
-            item.done.wait()
-        if item.error is not None:
-            raise item.error
-        return item.result
+            return fut.result()
+
+    def _ensure_thread(self) -> None:
+        t = self._thread
+        if t is not None and t.is_alive():
+            return
+        with self._thread_lock:
+            if self._closed:
+                return
+            if self._thread is None or not self._thread.is_alive():
+                # the dispatcher may have exited through the
+                # weakref-dead idle path (server briefly unreferenced);
+                # restart it — queues and stats survive
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name=self.name)
+                self._thread.start()
 
     def close(self) -> None:
-        """Stop the dispatcher thread (pending queries get an error)."""
-        with self._cv:
+        """Stop accepting queries, DRAIN what is queued (pending
+        queries get their results — a graceful shutdown answers its
+        stragglers), then stop the dispatcher thread. Idempotent."""
+        with self._thread_lock:
+            if self._closed:
+                return
             self._closed = True
-            pending, self._pending = self._pending, []
-            self._set_queue_gauge_locked()
-            self._cv.notify()
-        for it in pending:
-            it.error = RuntimeError("serving backend closed")
-            it.done.set()
+            thread = self._thread
+        self._wake.set()
+        if thread is threading.current_thread():
+            # called from inside a dispatch fn: the running loop sees
+            # _closed and drains after this dispatch returns
+            return
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=10.0)
+            if thread.is_alive():
+                # wedged inside a device dispatch past the join budget:
+                # the thread OWNS the lane queues — touching them here
+                # would race its pop loop (both sides claiming the same
+                # futures). When the dispatch unwedges, the loop drains
+                # under _closed and exits on its own.
+                return
+        # no dispatcher left, and enqueue can no longer append (the
+        # closed flag flipped under _thread_lock): fail what remains
+        with self._thread_lock:
+            self._drain_handoff()
+            for lane in self._lanes:
+                leftover, lane.queue = lane.queue, []
+                with self._stats_lock:
+                    lane.pending -= len(leftover)
+                for it in leftover:
+                    if it.future.set_running_or_notify_cancel():
+                        it.future.set_exception(
+                            RuntimeError("serving backend closed"))
+                self._set_queue_gauge(lane)
 
-    def _run(self):
+    # -- dispatcher thread -------------------------------------------------
+
+    def _drain_handoff(self) -> None:
         while True:
-            with self._cv:
-                while not self._pending and not self._closed:
-                    # timeout wake: exit when the server was dropped
-                    self._cv.wait(timeout=1.0)
-                    if not self._pending and self._srv_ref() is None:
-                        return
-                if self._closed and not self._pending:
-                    return
-                group = self._pending[:self._max]
-                del self._pending[:self._max]
-                self._set_queue_gauge_locked()
-            srv = self._srv_ref()
             try:
-                if srv is None:
-                    raise RuntimeError("serving backend was released")
-                self._dispatch_group(srv, group)
-                with self._cv:
-                    self.dispatches += 1
-                    self.batched_queries += len(group)
-                from predictionio_tpu.utils import metrics
+                lane, item = self._handoff.popleft()
+            except IndexError:
+                return
+            bisect.insort(lane.queue, item)
 
-                metrics.MICROBATCH_DISPATCHES.inc(batcher=self.name)
-                metrics.MICROBATCH_QUERIES.inc(amount=len(group),
-                                               batcher=self.name)
-                metrics.MICROBATCH_BATCH_SIZE.observe(len(group),
-                                                      batcher=self.name)
-            except BaseException as e:  # propagate to every waiter
-                for it in group:
-                    it.error = e
-            finally:
-                del srv  # never hold the server across the cv wait
-                for it in group:
-                    it.done.set()
+    def _set_queue_gauge(self, lane: BatchLane) -> None:
+        from predictionio_tpu.utils import metrics
 
-    def _dispatch_group(self, srv: "DeviceTopK",
-                        group: List[_PendingQuery]) -> None:
-        raise NotImplementedError
+        metrics.MICROBATCH_QUEUE_DEPTH.set(lane.pending,
+                                           batcher=lane.name)
 
-    @staticmethod
-    def _scatter_results(group, idx: np.ndarray,
-                         scores: np.ndarray) -> None:
-        """Row r of the batched (idx, scores) -> request r's result,
-        clipped to its own k with non-candidates filtered."""
-        for row, it in enumerate(group):
-            ri = idx[row, :it.k]
-            rs = scores[row, :it.k]
-            valid = np.isfinite(rs)
-            it.result = (ri[valid], rs[valid])
+    def _all_empty(self) -> bool:
+        return not self._handoff and all(not ln.queue
+                                         for ln in self._lanes)
+
+    def _pick(self, now: float) -> Tuple[Optional[BatchLane],
+                                         Optional[str]]:
+        """The lane to dispatch NOW, with its trigger — a full lane
+        first, else the lane whose earliest deadline has expired
+        (earliest wins across lanes), else nothing yet."""
+        best: Optional[BatchLane] = None
+        best_deadline = 0.0
+        for lane in self._lanes:
+            q = lane.queue
+            if not q:
+                continue
+            if self._closed:
+                return lane, "drain"
+            if len(q) >= lane.max_batch:
+                return lane, "size"
+            d = q[0].deadline
+            if d <= now and (best is None or d < best_deadline):
+                best, best_deadline = lane, d
+        return (best, "window") if best is not None else (None, None)
+
+    def _next_delay(self, now: float) -> Optional[float]:
+        earliest: Optional[float] = None
+        for lane in self._lanes:
+            if lane.queue:
+                d = lane.queue[0].deadline
+                if earliest is None or d < earliest:
+                    earliest = d
+        return None if earliest is None else max(0.0, earliest - now)
+
+    def _run(self) -> None:
+        while True:
+            self._wake.clear()
+            self._drain_handoff()
+            now = time.monotonic()
+            lane, trigger = self._pick(now)
+            if lane is not None:
+                self._dispatch(lane, trigger)
+                continue
+            if self._closed:
+                if self._all_empty():
+                    return
+                continue
+            delay = self._next_delay(now)
+            if delay is None:
+                # idle: bounded wait, exit when the owner was dropped
+                if not self._wake.wait(1.0) and self._srv_ref() is None:
+                    with self._thread_lock:
+                        self._drain_handoff()
+                        if self._all_empty():
+                            self._thread = None
+                            return
+            elif delay > 0:
+                self._wake.wait(delay)
+
+    def _dispatch(self, lane: BatchLane, trigger: str) -> None:
+        q = lane.queue
+        with self._stats_lock:
+            depth = lane.pending  # waiting anywhere, handoff included
+        group: List[_Pending] = []
+        popped = 0
+        while q and len(group) < lane.max_batch:
+            it = q.pop(0)  # EDF: earliest deadline forms the batch
+            popped += 1
+            # a False return means the waiter shed it (queue-deadline
+            # 503) — drop it from the batch
+            if it.future.set_running_or_notify_cancel():
+                group.append(it)
+        with self._stats_lock:
+            lane.pending -= popped
+        self._set_queue_gauge(lane)
+        if not group:
+            return
+        srv = self._srv_ref()
+        try:
+            if srv is None:
+                raise RuntimeError("serving backend was released")
+            lane.dispatch_fn(srv, group)
+        except BaseException as e:  # propagate to every waiter
+            for it in group:
+                if not it.future.done():
+                    it.future.set_exception(e)
+        finally:
+            del srv  # never hold the server across the idle wait
+            for it in group:
+                if not it.future.done():
+                    it.future.set_exception(RuntimeError(
+                        "batch dispatch completed without a result"))
+        with self._stats_lock:
+            lane.dispatches += 1
+            lane.batched_queries += len(group)
+            lane.triggers[trigger] += 1
+            lane.depth_samples.append(depth)
+        from predictionio_tpu.utils import metrics
+
+        metrics.MICROBATCH_DISPATCHES.inc(batcher=lane.name)
+        metrics.MICROBATCH_QUERIES.inc(amount=len(group),
+                                       batcher=lane.name)
+        metrics.MICROBATCH_BATCH_SIZE.observe(len(group),
+                                              batcher=lane.name)
+        metrics.MICROBATCH_TRIGGERS.inc(batcher=lane.name,
+                                        trigger=trigger)
+        metrics.MICROBATCH_FILL.observe(len(group) / lane.max_batch,
+                                        batcher=lane.name)
+        metrics.MICROBATCH_QUEUE_AT_DISPATCH.observe(depth,
+                                                     batcher=lane.name)
 
 
-class _UserBatcher(_MicroBatcher):
-    """Per-user top-k requests -> one ``users_topk`` dispatch."""
-
-    def _dispatch_group(self, srv, group):
-        kmax = max(it.k for it in group)
-        n = len(group)
-        uids = np.asarray([it.uid for it in group], dtype=np.int64)
-        if n > 8:
-            # pad to the ONE large uid bucket so live traffic only ever
-            # needs the two batch programs warmup compiled (8 and
-            # max_batch) — hard part #4: no query may pay a serve-time
-            # XLA compile
-            padded = np.zeros(self._max, dtype=np.int64)
-            padded[:n] = uids
-            idx, scores = srv.users_topk(padded, kmax)
-        else:
-            idx, scores = srv.users_topk(uids, kmax)
-        self._scatter_results(group, idx, scores)
+def _dispatch_user_group(srv: "DeviceTopK",
+                         group: List[_Pending]) -> None:
+    """Per-user top-k requests -> one ``users_topk`` dispatch (the
+    batch pads to its power-of-two uid bucket inside ``users_topk``;
+    every ladder bucket is AOT-precompiled, so arbitrary group sizes
+    never pay a serve-time compile)."""
+    kmax = max(it.k for it in group)
+    uids = np.asarray([it.payload for it in group], dtype=np.int64)
+    idx, scores = srv.users_topk(uids, kmax)
+    res = _BatchResult(idx, scores)
+    for row, it in enumerate(group):
+        if not it.future.done():
+            it.future.set_result((res, row))
 
 
-class _ItemBatcher(_MicroBatcher):
+def _dispatch_item_group(srv: "DeviceTopK",
+                         group: List[_Pending]) -> None:
     """Item-similarity requests (each a tuple of query-item indices) ->
-    one vmapped ``_items_topk`` dispatch. The group pads to 8 or
-    max_batch rows (warmed buckets) and each row's item list to the
-    group's common power-of-two length."""
+    one vmapped ``_items_topk`` dispatch: the group pads to its
+    power-of-two row bucket, each row's item list to the group's common
+    power-of-two length."""
+    kmax = max(it.k for it in group)
+    n = len(group)
+    B = srv.ITEM_QUERY_BUCKET
+    while B < max(len(it.payload) for it in group):
+        B *= 2
+    G = _bucket(n, lo=8)
+    idxs = np.zeros((G, B), dtype=np.int32)
+    masks = np.zeros((G, B), dtype=np.float32)
+    for row, it in enumerate(group):
+        m = len(it.payload)
+        idxs[row, :m] = np.asarray(it.payload, dtype=np.int32)
+        masks[row, :m] = 1.0
+    idx, scores = srv._items_topk_batched(idxs, masks, kmax)
+    res = _BatchResult(idx, scores)
+    for row, it in enumerate(group):
+        if not it.future.done():
+            it.future.set_result((res, row))
 
-    name = "pio-microbatch-items"
 
-    def _dispatch_group(self, srv, group):
-        kmax = max(it.k for it in group)
-        n = len(group)
-        B = srv.ITEM_QUERY_BUCKET
-        while B < max(len(it.uid) for it in group):
-            B *= 2
-        G = 8 if n <= 8 else self._max  # the two warmed group buckets
-        idxs = np.zeros((G, B), dtype=np.int32)
-        masks = np.zeros((G, B), dtype=np.float32)
-        for row, it in enumerate(group):
-            m = len(it.uid)
-            idxs[row, :m] = np.asarray(it.uid, dtype=np.int32)
-            masks[row, :m] = 1.0
-        idx, scores = srv._items_topk_batched(idxs, masks, kmax)
-        self._scatter_results(group, idx, scores)
+_live_servers: "weakref.WeakSet[DeviceTopK]" = weakref.WeakSet()
+
+
+def batcher_stats() -> List[Dict[str, Any]]:
+    """Every live micro-batch lane's unified stats, process-wide — the
+    ``/stats.json`` ``batchers`` surface (user and item lanes share one
+    shape; see :meth:`BatchLane.stats`)."""
+    out: List[Dict[str, Any]] = []
+    for srv in list(_live_servers):
+        try:
+            out.extend(srv.stats().values())
+        except Exception:  # a server mid-teardown must not 500 /stats
+            continue
+    return out
 
 
 _scatter_jits: Dict[bool, object] = {}
@@ -645,7 +956,7 @@ class DeviceTopK:
     used as-is, so a PAlgorithm model's HBM shards serve directly.
 
     Concurrent ``user_topk`` callers are micro-batched into one device
-    dispatch (see :class:`_MicroBatcher`); set ``microbatch=False`` or
+    dispatch (see :class:`BatchDispatcher`); set ``microbatch=False`` or
     ``PIO_SERVING_MICROBATCH=0`` to dispatch per call.
 
     The user factor store is LIVE-PATCHABLE (:meth:`patch_users`, the
@@ -671,9 +982,17 @@ class DeviceTopK:
             microbatch = os.environ.get(
                 "PIO_SERVING_MICROBATCH",
                 "1").strip().lower() not in ("0", "off", "false")
-        self._batcher = _UserBatcher(self) if microbatch else None
-        self._item_batcher = _ItemBatcher(self, max_batch=64) \
-            if microbatch else None
+        self._dispatcher: Optional[BatchDispatcher] = None
+        self._batcher: Optional[BatchLane] = None
+        self._item_batcher: Optional[BatchLane] = None
+        if microbatch:
+            self._dispatcher = BatchDispatcher(self)
+            self._batcher = self._dispatcher.add_lane(
+                "pio-microbatch", max_batch=256,
+                dispatch_fn=_dispatch_user_group)
+            self._item_batcher = self._dispatcher.add_lane(
+                "pio-microbatch-items", max_batch=64,
+                dispatch_fn=_dispatch_item_group)
 
         self._X = (user_factors if hasattr(user_factors, "sharding")
                    else jnp.asarray(user_factors))
@@ -705,7 +1024,13 @@ class DeviceTopK:
         self._user_programs: Dict[int, object] = {}
         self._batch_programs: Dict[Tuple[int, int], object] = {}
         self._item_programs: Dict[object, object] = {}
+        # AOT-compiled ladder executables (warmup/precompile): keyed by
+        # (store signature, program shape) so a store reshaped by
+        # fold-in growth can never hit a stale executable — the jit
+        # program caches above stay as the always-correct fallback
+        self._aot_programs = AOTCache(max_entries=512)
         self._Yn = None  # normalized item matrix, built on first item query
+        _live_servers.add(self)
 
     def _replicate_like_factors(self, arr):
         """When the factors are sharded over a mesh, pin auxiliary tables
@@ -753,46 +1078,167 @@ class DeviceTopK:
             self._Yn = _normalize_rows(self._Y)
         return self._Yn
 
-    def warmup(self, max_k: int = 128, batch_sizes: Tuple[int, ...] = ()) \
-            -> None:
-        """Compile + run EVERY bucket program up to ``max_k`` (deploy-time
-        AOT so no live query in that range ever pays a compile — SURVEY
-        hard part #4). ``batch_sizes`` additionally warms the batched
-        multi-query programs at those uid-bucket sizes; with
-        micro-batching on, the two uid buckets the batcher dispatches at
-        (8 and its max batch) are always included."""
-        batch_sizes = tuple(batch_sizes)
-        if self._batcher is not None:
-            extra = {8, self._batcher._max} - set(batch_sizes)
-            batch_sizes += tuple(sorted(extra))
+    # -- AOT bucket ladder -------------------------------------------------
+
+    def _store_sig_locked(self) -> Tuple:
+        """Abstract signature of the live store — what every serving
+        program's compilation is keyed on. AOT executables are cached
+        under it, so a store reshaped by fold-in growth misses cleanly
+        (and takes the jit fallback) instead of crashing a stale
+        executable. Caller holds ``_store_lock``."""
+        return (tuple(self._X.shape), str(self._X.dtype),
+                tuple(self._Y.shape), str(self._Y.dtype),
+                tuple(self._seen_cols.shape))
+
+    def _aot_get_locked(self, entry: Tuple):
+        return self._aot_programs.get((self._store_sig_locked(), entry))
+
+    def aot_plan(self, max_k: int = 128,
+                 batch_sizes: Tuple[int, ...] = ()) -> List[Tuple]:
+        """The FULL power-of-two program ladder live traffic can
+        dispatch at — the single enumeration both the AOT precompiler
+        (:meth:`warmup`/:meth:`precompile`) and the deploy-time
+        ``workflow.create_server.warm_up`` consult, so warm-up coverage
+        and AOT coverage can never diverge.
+
+        Entries: ``("user", kb)`` single-query programs, ``("users",
+        kb, bb)`` vmapped uid-bucket programs, ``("items", kb, B, gg)``
+        vmapped item-similarity programs. ``kb`` sweeps the k buckets
+        16,32,... up to ``max_k`` (clipped to ``n_items``); ``bb``/
+        ``gg`` sweep 8,16,... up to each lane's max batch (plus any
+        requested ``batch_sizes``, bucketed)."""
+        ks: List[int] = []
         k = 16
         while True:
-            self.user_topk(0, min(k, self.n_items))
-            for b in batch_sizes:
-                self.users_topk(np.zeros(b, dtype=np.int64),
-                                min(k, self.n_items))
+            kb = min(k, self.n_items)
+            if kb >= 1 and kb not in ks:
+                ks.append(kb)
             if k >= max_k or k >= self.n_items:
                 break
             k *= 2
-        self.items_topk([0], min(16, self.n_items))
-        if self._item_batcher is not None:
-            # the large item-group bucket at the base item-list length
-            # (queries with longer item lists may still compile at
-            # serve time — same contract as before batching)
-            B = self.ITEM_QUERY_BUCKET
-            for g in (8, self._item_batcher._max):
+        bmax = self._batcher.max_batch if self._batcher is not None else 8
+        for b in batch_sizes:
+            bmax = max(bmax, _bucket(int(b), lo=8))
+        user_buckets = []
+        b = 8
+        while b <= bmax:
+            user_buckets.append(b)
+            b *= 2
+        gmax = self._item_batcher.max_batch \
+            if self._item_batcher is not None else 8
+        item_buckets = []
+        g = 8
+        while g <= gmax:
+            item_buckets.append(g)
+            g *= 2
+        plan: List[Tuple] = []
+        for kb in ks:
+            plan.append(("user", kb))
+            for bb in user_buckets:
+                plan.append(("users", kb, bb))
+            for gg in item_buckets:
+                plan.append(("items", kb, self.ITEM_QUERY_BUCKET, gg))
+        return plan
+
+    def precompile(self, plan: List[Tuple]) -> Dict[str, int]:
+        """AOT-compile every ladder program (``lower().compile()``, no
+        device execution, a small thread pool hides XLA's per-program
+        latency) into the executable cache the dispatch paths consult
+        first. Best-effort per entry: a program AOT declines stays on
+        the jit fallback, which :meth:`warmup` then compiles by
+        executing it once — still at deploy time, never on a query.
+        ``PIO_SERVE_AOT=0`` skips AOT entirely (everything falls back).
+        """
+        if not _serve_aot_enabled():
+            return {"compiled": 0, "fallback": len(plan)}
+        import jax
+        import jax.numpy as jnp
+
+        with self._store_lock:
+            X, Y = self._X, self._Y
+            sc, sm = self._seen_cols, self._seen_mask
+            sig = self._store_sig_locked()
+        Yn = self._normalized_items() \
+            if any(e[0] == "items" for e in plan) else None
+
+        def build(entry: Tuple):
+            kind = entry[0]
+            if kind == "user":
+                fn = jax.jit(partial(_user_topk, k=entry[1],
+                                     mask_seen=self._mask_seen,
+                                     n_items=self.n_items))
+                return entry, lower_compile(
+                    fn, X, Y, sc, sm,
+                    jax.ShapeDtypeStruct((), jnp.int32))
+            if kind == "users":
+                _, kb, bb = entry
+                fn = jax.jit(jax.vmap(
+                    partial(_user_topk, k=kb, mask_seen=self._mask_seen,
+                            n_items=self.n_items),
+                    in_axes=(None, None, None, None, 0)))
+                return entry, lower_compile(
+                    fn, X, Y, sc, sm,
+                    jax.ShapeDtypeStruct((bb,), jnp.int32))
+            _, kb, B, gg = entry
+            fn = jax.jit(jax.vmap(
+                partial(_items_topk, k=kb, n_items=self.n_items),
+                in_axes=(None, 0, 0)))
+            return entry, lower_compile(
+                fn, Yn, jax.ShapeDtypeStruct((gg, B), jnp.int32),
+                jax.ShapeDtypeStruct((gg, B), jnp.float32))
+
+        compiled = fallback = 0
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(4, max(1, len(plan))),
+                                thread_name_prefix="pio-serve-aot") \
+                as pool:
+            for entry, prog in pool.map(build, plan):
+                if prog is None:
+                    fallback += 1
+                else:
+                    compiled += 1
+                    self._aot_programs.put((sig, entry), prog)
+        return {"compiled": compiled, "fallback": fallback}
+
+    def warmup(self, max_k: int = 128, batch_sizes: Tuple[int, ...] = ()) \
+            -> Dict[str, int]:
+        """Make EVERY ladder program up to ``max_k`` serve-ready at
+        deploy time (SURVEY hard part #4: no live query may ever pay an
+        XLA compile — asserted by the jit-compile monitor in
+        ``bench.serving_load_bench``): AOT-precompile the full
+        :meth:`aot_plan` ladder, execute the handful AOT declined so
+        their jit fallbacks compile NOW, then run one sacrificial query
+        per lane to pin the runtime dispatch caches. ``batch_sizes``
+        extends the uid-bucket ladder for callers with known batch
+        shapes (bench/batchpredict)."""
+        plan = self.aot_plan(max_k=max_k, batch_sizes=tuple(batch_sizes))
+        stats = self.precompile(plan)
+        with self._store_lock:
+            missing = [e for e in plan if self._aot_get_locked(e) is None]
+        for entry in missing:  # jit-compile the stragglers by running
+            if entry[0] == "user":
+                self._user_topk_direct(0, entry[1])
+            elif entry[0] == "users":
+                _, kb, bb = entry
+                self.users_topk(np.zeros(bb, dtype=np.int64), kb)
+            else:
+                _, kb, B, gg = entry
                 self._items_topk_batched(
-                    np.zeros((g, B), dtype=np.int32),
-                    np.zeros((g, B), dtype=np.float32),
-                    min(16, self.n_items))
+                    np.zeros((gg, B), dtype=np.int32),
+                    np.zeros((gg, B), dtype=np.float32), kb)
+        kmin = min(16, self.n_items)
+        self.user_topk(0, kmin)
+        self.users_topk(np.zeros(8, dtype=np.int64), kmin)
+        self.items_topk([0], kmin)
+        return stats
 
     def close(self) -> None:
-        """Release the micro-batch dispatchers (idempotent). Dropping
-        the last reference also stops them within their wait timeout."""
-        if self._batcher is not None:
-            self._batcher.close()
-        if self._item_batcher is not None:
-            self._item_batcher.close()
+        """Release the micro-batch dispatcher (drains pending queries,
+        idempotent). Dropping the last reference also stops it within
+        its wait timeout."""
+        if self._dispatcher is not None:
+            self._dispatcher.close()
 
     def stats(self) -> Dict[str, Dict[str, int]]:
         """Micro-batcher counters (consistent snapshots; also exported
@@ -825,9 +1271,10 @@ class DeviceTopK:
         programs; the uid rides inside the async jit dispatch."""
         kb = min(_bucket(k), self.n_items)
         with self._store_lock:
-            out = self._user_program(kb)(
-                self._X, self._Y, self._seen_cols, self._seen_mask,
-                np.int32(uid))
+            prog = self._aot_get_locked(("user", kb)) \
+                or self._user_program(kb)
+            out = prog(self._X, self._Y, self._seen_cols,
+                       self._seen_mask, np.int32(uid))
         idx, scores = _unpack(np.asarray(out), kb)
         idx, scores = idx[:k], scores[:k]
         valid = np.isfinite(scores)
@@ -852,9 +1299,10 @@ class DeviceTopK:
             padded[:n] = uids
             kb = min(_bucket(k), self.n_items)
             with self._store_lock:
-                out = self._batch_program(kb, bb)(
-                    self._X, self._Y, self._seen_cols, self._seen_mask,
-                    padded)
+                prog = self._aot_get_locked(("users", kb, bb)) \
+                    or self._batch_program(kb, bb)
+                out = prog(self._X, self._Y, self._seen_cols,
+                           self._seen_mask, padded)
             idx, scores = _unpack(np.asarray(out), kb)
             return idx[:n, :k], scores[:n, :k]
 
@@ -890,21 +1338,20 @@ class DeviceTopK:
                             k: int) -> Tuple[np.ndarray, np.ndarray]:
         """vmap of the item-similarity program over a [G, B] query
         bucket: G concurrent item queries, one dispatch, one fetch."""
-        import jax.numpy as jnp
-
         G, B = idxs.shape
         kb = min(_bucket(k), self.n_items)
-        prog = self._item_programs.get((kb, B, G))
-        if prog is None:
-            import jax
-
-            prog = jax.jit(jax.vmap(
-                partial(_items_topk, k=kb, n_items=self.n_items),
-                in_axes=(None, 0, 0)))
-            self._item_programs[(kb, B, G)] = prog
         with self._store_lock:
-            out = prog(self._normalized_items(), jnp.asarray(idxs),
-                       jnp.asarray(masks))
+            prog = self._aot_get_locked(("items", kb, B, G))
+            if prog is None:
+                prog = self._item_programs.get((kb, B, G))
+                if prog is None:
+                    import jax
+
+                    prog = jax.jit(jax.vmap(
+                        partial(_items_topk, k=kb, n_items=self.n_items),
+                        in_axes=(None, 0, 0)))
+                    self._item_programs[(kb, B, G)] = prog
+            out = prog(self._normalized_items(), idxs, masks)
         idx, scores = _unpack(np.asarray(out), kb)
         return idx, scores
 
@@ -967,6 +1414,7 @@ class DeviceTopK:
         if uids.min() < 0:
             raise ValueError("patch_users: negative user index")
         with self._store_lock:
+            sig_before = self._store_sig_locked()
             # phase 1 — everything that can FAIL, with no live buffer
             # donated yet: growth builds new arrays (the old store stays
             # whole), seen prep is pads + host loops. Only after all of
@@ -1010,6 +1458,13 @@ class DeviceTopK:
                     cols, mask, sids, row_c, row_m)
             self._X = _scatter_rows(X, uids, factors)
             self.n_users = max(self.n_users, needed)
+            if self._store_sig_locked() != sig_before:
+                # grown store: AOT executables are keyed by store
+                # signature so lookups would miss anyway — drop them
+                # eagerly (each pins device code); dispatch falls back
+                # to the shape-polymorphic jit programs until the next
+                # warmup()/precompile() re-ladders the new shape
+                self._aot_programs.clear()
 
     def _prep_seen_locked(self, seen_items: Dict[int, np.ndarray],
                           n_rows: int):

@@ -197,6 +197,9 @@ class _AdminHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
     operator surfaces ``GET /metrics`` / ``GET /traces.json``."""
 
     admin_server: AdminServer
+    # keep-alive (same as the event/query servers): scrapers and CLI
+    # polls reuse one TCP connection instead of a handshake per request
+    protocol_version = "HTTP/1.1"
     metrics_server_label = "admin"
 
     def log_message(self, fmt, *args):  # route through logging, not stderr

@@ -92,10 +92,12 @@ def _add_tracing_args(p: argparse.ArgumentParser) -> None:
 def _add_serve_precision_arg(p: argparse.ArgumentParser) -> None:
     p.add_argument("--serve-precision", choices=("fp32", "bf16"),
                    default=None,
-                   help="serving factor-store precision (default fp32; "
-                        "env PIO_SERVE_PRECISION). bf16 halves the "
-                        "model's HBM and scoring traffic; scores still "
-                        "accumulate fp32")
+                   help="serving factor-store precision (env "
+                        "PIO_SERVE_PRECISION; device stores default to "
+                        "bf16 on accelerators, fp32 on CPU). bf16 "
+                        "halves the model's HBM and scoring traffic; "
+                        "scores still accumulate fp32. fp32 is the "
+                        "opt-out; the host lane is always fp32")
 
 
 def _add_distributed_args(p: argparse.ArgumentParser) -> None:
@@ -234,6 +236,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "retrain (forces the DeviceTopK backend; "
                           "cadence via PIO_FOLDIN_INTERVAL / "
                           "PIO_FOLDIN_COUNT)")
+    dep.add_argument("--batch-window", type=float, default=None,
+                     metavar="SEC",
+                     help="micro-batch budget in seconds (default "
+                          "0.002; env PIO_BATCH_WINDOW): how long the "
+                          "dispatcher holds a lone query hoping more "
+                          "arrive to share its device dispatch; 0 "
+                          "dispatches as soon as the dispatcher is "
+                          "free")
     _add_metrics_arg(dep)
     _add_tracing_args(dep)
     _add_serve_precision_arg(dep)

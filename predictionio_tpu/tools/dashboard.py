@@ -212,6 +212,10 @@ class _DashboardHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
     key-authed routes stay authed)."""
 
     dashboard: Dashboard
+    # keep-alive (same as the event/query servers): a Prometheus
+    # scraper or pio-trace poller reuses one TCP connection instead of
+    # paying a handshake per request
+    protocol_version = "HTTP/1.1"
     metrics_server_label = "dashboard"
 
     def log_message(self, fmt, *args):

@@ -103,6 +103,13 @@ def _apply_precision_flags(args) -> None:
     serve_precision = getattr(args, "serve_precision", None)
     if serve_precision:
         os.environ["PIO_SERVE_PRECISION"] = serve_precision
+    # --batch-window -> $PIO_BATCH_WINDOW: the micro-batch dispatcher
+    # resolves the budget at construction, same env-as-truth discipline
+    batch_window = getattr(args, "batch_window", None)
+    if batch_window is not None:
+        if batch_window < 0:
+            raise SystemExit("--batch-window must be >= 0")
+        os.environ["PIO_BATCH_WINDOW"] = repr(float(batch_window))
 
 
 def cmd_train(args) -> int:

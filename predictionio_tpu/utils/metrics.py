@@ -496,6 +496,24 @@ MICROBATCH_BATCH_SIZE = REGISTRY.histogram(
     "pio_microbatch_batch_size",
     "Queries merged into one device dispatch",
     ("batcher",), buckets=COUNT_BUCKETS)
+MICROBATCH_TRIGGERS = REGISTRY.counter(
+    "pio_microbatch_dispatch_triggers_total",
+    "Dispatches by what formed the batch (size = max_batch reached; "
+    "window = the oldest query's PIO_BATCH_WINDOW budget expired; "
+    "drain = shutdown flush)",
+    ("batcher", "trigger"))
+# fill ratio needs its own bounds: COUNT_BUCKETS are absolute sizes,
+# but a half-full 256-batch and a half-full 8-batch mean the same thing
+FILL_BUCKETS = (0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+MICROBATCH_FILL = REGISTRY.histogram(
+    "pio_microbatch_fill_ratio",
+    "Dispatched batch size as a fraction of the lane's max_batch",
+    ("batcher",), buckets=FILL_BUCKETS)
+MICROBATCH_QUEUE_AT_DISPATCH = REGISTRY.histogram(
+    "pio_microbatch_queue_depth_at_dispatch",
+    "Pending queue depth observed at each dispatch (the percentile "
+    "source for batcher_stats queueDepthPercentiles)",
+    ("batcher",), buckets=COUNT_BUCKETS)
 
 # -- storage ---------------------------------------------------------------
 STORAGE_OP_LATENCY = REGISTRY.histogram(

@@ -332,14 +332,29 @@ def warm_up(dep: Deployment,
             warmup_query: Optional[Mapping[str, Any]] = None) -> None:
     """AOT-compile the predict path before the first real query (SURVEY
     hard part #4): per-algorithm ``warmup_base`` hooks, then an optional
-    sacrificial query through the full serve path."""
+    sacrificial query through the full serve path.
+
+    Bucket coverage is NOT enumerated here: every device-served model
+    warms through ``DeviceTopK.warmup()``, which precompiles the full
+    ``DeviceTopK.aot_plan()`` power-of-two ladder (every (k, batch)
+    program live traffic can dispatch at). One enumeration, consulted
+    by both deploy warm-up and the AOT precompiler, so they can never
+    diverge — the old per-bucket warm loop here could (and did) warm
+    only the default bucket. Models without a ``warmup_base`` hook but
+    with a ``device_server()`` still get the ladder."""
     for algo, model in zip(dep.algorithms, dep.models):
         warmup = getattr(algo, "warmup_base", None)
-        if callable(warmup):
-            try:
+        try:
+            if callable(warmup):
                 warmup(model)
-            except Exception:
-                logger.exception("warmup_base failed (non-fatal)")
+            else:
+                # hook-less device-served models must not skip the
+                # ladder: first queries would pay serve-time compiles
+                device_server = getattr(model, "device_server", None)
+                if callable(device_server):
+                    device_server().warmup()
+        except Exception:
+            logger.exception("warmup_base failed (non-fatal)")
     if warmup_query is not None:
         try:
             query = query_from_json(dict(warmup_query),
@@ -458,6 +473,13 @@ class QueryServer:
     def deploy(self) -> "QueryServer":
         """Load + warm the engine (createServerActorWithEngine,
         CreateServer.scala:213-272)."""
+        # the serve-time compile monitor must be LIVE in a deployed
+        # process (idempotent, no-op when metrics are off): the AOT
+        # ladder's zero-compile contract is only checkable if
+        # pio_jit_compiles_total actually counts — warm-up compiles
+        # land in the counter, a flat counter under traffic proves no
+        # query ever paid one
+        metrics.install_jit_compile_listener()
         if self.config.foldin:
             # before the model loads: choose_server must see the policy
             # (fold-in needs the updatable DeviceTopK store) whether the
@@ -759,11 +781,17 @@ class QueryServer:
         }
 
     def stats_json(self) -> Dict[str, Any]:
-        """GET /stats.json: the status page plus the process-wide
-        registry snapshot (pio_query_seconds, pio_microbatch_*,
-        pio_storage_op_* ... — the same state GET /metrics renders as
-        Prometheus text)."""
-        return {**self.status(), "metrics": metrics.registry().snapshot()}
+        """GET /stats.json: the status page, the live micro-batch
+        lanes' unified ``batcher_stats`` (dispatch triggers, batch-fill
+        ratio, queue-depth percentiles — one shape for user and item
+        lanes), plus the process-wide registry snapshot
+        (pio_query_seconds, pio_microbatch_*, pio_storage_op_* ... —
+        the same state GET /metrics renders as Prometheus text)."""
+        from predictionio_tpu.ops import serving as _serving
+
+        return {**self.status(),
+                "batchers": _serving.batcher_stats(),
+                "metrics": metrics.registry().snapshot()}
 
     def health_checks(self) -> Dict[str, bool]:
         """Readiness for ``GET /healthz``: a deployment is loaded, the
@@ -956,7 +984,17 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
                     return
                 self._respond(200, {"message": "Reloading...", **info})
             elif path == "/stop":
-                self._respond(200, {"message": "Shutting down."})
+                # the server is about to die: tell keep-alive clients
+                # (HTTP/1.1 connections persist by default) not to
+                # reuse this connection, and close it after the
+                # response instead of waiting out the read timeout
+                self.close_connection = True
+                self._respond_bytes(
+                    200,
+                    json.dumps({"message": "Shutting down."})
+                    .encode("utf-8"),
+                    "application/json; charset=UTF-8",
+                    extra_headers={"Connection": "close"})
                 threading.Thread(target=srv.stop, daemon=True).start()
             else:
                 self._respond(404, {"message": "Not Found"})

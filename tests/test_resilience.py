@@ -1491,9 +1491,12 @@ class TestHealthz:
 
 class TestMicroBatcherDeadline:
     def test_queued_past_deadline_rejected(self, monkeypatch):
+        import numpy as np
+
         from predictionio_tpu.ops.serving import (
+            BatchDispatcher,
             QueryRejectedError,
-            _MicroBatcher,
+            _BatchResult,
         )
 
         monkeypatch.setenv("PIO_QUERY_QUEUE_DEADLINE", "0.2")
@@ -1503,31 +1506,38 @@ class TestMicroBatcherDeadline:
         class Dummy:
             pass
 
-        class Blocking(_MicroBatcher):
-            name = "pio-test-batch"
+        def blocking_dispatch(srv, group):
+            started.set()
+            release.wait(10)
+            res = _BatchResult(np.zeros((len(group), 5), dtype=np.int32),
+                               np.ones((len(group), 5), dtype=np.float32))
+            for row, it in enumerate(group):
+                it.future.set_result((res, row))
 
-            def _dispatch_group(self, srv, group):
-                started.set()
-                release.wait(10)
-
-        server = Dummy()
-        mb = Blocking(server, max_batch=1)
-        t1 = threading.Thread(target=lambda: mb.submit(0, 5), daemon=True)
+        server = Dummy()  # kept referenced: the dispatcher weakrefs it
+        d = BatchDispatcher(server, window=0.0)
+        lane = d.add_lane("pio-test-batch", max_batch=1,
+                          dispatch_fn=blocking_dispatch)
+        t1 = threading.Thread(target=lambda: lane.submit(0, 5),
+                              daemon=True)
         t1.start()
         assert started.wait(5), "first query never dispatched"
         before = metrics.MICROBATCH_REJECTIONS.value(
             batcher="pio-test-batch")
         t0 = time.perf_counter()
         with pytest.raises(QueryRejectedError) as ei:
-            mb.submit(1, 5)  # stuck in queue behind the blocked dispatch
+            # stuck behind the blocked dispatch (max_batch=1 means it
+            # can never join the in-flight group)
+            lane.submit(1, 5)
         took = time.perf_counter() - t0
         assert 0.15 < took < 5.0, f"rejection took {took}s"
         assert ei.value.retry_after >= 1.0
         assert metrics.MICROBATCH_REJECTIONS.value(
             batcher="pio-test-batch") == before + 1
+        assert lane.stats()["rejectedQueries"] == 1
         release.set()
         t1.join(5)
-        mb.close()
+        d.close()
 
     def test_http_503_with_retry_after(self, monkeypatch, ecomm_stack):
         """The query server maps QueryRejectedError to 503 + the

@@ -220,21 +220,19 @@ class TestMicroBatching:
     def test_mixed_k_in_one_group(self, factors):
         X, Y, seen = factors
         srv = DeviceTopK(X, Y, seen)
-        # force one group by stuffing the queue before the thread starts
+        # a generous batching window lets all five queries join ONE
+        # EDF batch despite arriving sequentially
         b = srv._batcher
-        from predictionio_tpu.ops.serving import _PendingQuery
-
-        items = [_PendingQuery(u, k) for u, k in
-                 [(0, 2), (1, 7), (2, 4), (3, 1)]]
-        with b._cv:
-            b._pending.extend(items)
-        b.submit(4, 5)  # starts the dispatcher, joins the same queue
-        for it in items:
-            assert it.done.wait(timeout=10)
-            assert it.error is None
-            idx, scores = it.result
-            want_idx, _ = host_oracle_topk(X, Y, seen, it.uid, it.k)
+        d0 = b.dispatches
+        futs = {(u, k): b.submit_async(u, k, window=0.5)
+                for u, k in [(0, 2), (1, 7), (2, 4), (3, 1), (4, 5)]}
+        for (u, k), fut in futs.items():
+            res, row = fut.result(timeout=10)
+            idx, scores = res.render(row, k)
+            want_idx, _ = host_oracle_topk(X, Y, seen, u, k)
             assert idx.tolist() == want_idx.tolist()
+        assert b.dispatches == d0 + 1  # one shared dispatch
+        assert b.stats()["dispatchTriggers"]["window"] >= 1
 
     def test_error_propagates_to_all_waiters(self, factors):
         X, Y, seen = factors
@@ -257,22 +255,23 @@ class TestMicroBatching:
         assert idx.tolist() == want_idx.tolist()
 
     def test_large_group_uses_warmed_bucket(self, factors):
-        """A group larger than 8 pads to the batcher's max bucket so
-        live traffic only ever hits the two warmed batch programs."""
+        """A group larger than 8 pads to its power-of-two uid bucket —
+        which the AOT ladder precompiled, so live traffic never compiles
+        a new batch program."""
         X, Y, seen = factors
         srv = DeviceTopK(X, Y, seen)
         srv.warmup(max_k=16)
-        compiled = set(srv._batch_programs)
+        compiled = set(srv._batch_programs)  # jit fallbacks, if any
         b = srv._batcher
-        from predictionio_tpu.ops.serving import _PendingQuery
-
-        items = [_PendingQuery(u % X.shape[0], 3) for u in range(20)]
-        with b._cv:
-            b._pending.extend(items)
-        b.submit(0, 3)
-        for it in items:
-            assert it.done.wait(timeout=10) and it.error is None
-        # no NEW batch program was compiled by the 21-query group
+        d0 = b.dispatches
+        futs = [b.submit_async(u % X.shape[0], 3, window=0.5)
+                for u in range(21)]
+        for fut in futs:
+            res, row = fut.result(timeout=10)
+            assert res.render(row, 3)[0] is not None
+        assert b.dispatches == d0 + 1  # the 21 queries shared one batch
+        # no NEW jit batch program was compiled by the 21-query group
+        # (bucket 32 came from the AOT ladder)
         assert set(srv._batch_programs) == compiled
 
     def test_item_queries_batched_and_correct(self, factors):
@@ -323,16 +322,16 @@ class TestMicroBatching:
         srv = DeviceTopK(X, Y, seen)
         srv.warmup(max_k=16)
         compiled = set(srv._item_programs)
-        # a full group of base-length item queries hits warmed programs
-        from predictionio_tpu.ops.serving import _PendingQuery
-
+        # a 13-query group (row bucket 16, from the AOT ladder) hits
+        # warmed programs only
         b = srv._item_batcher
-        items = [_PendingQuery((u % 33,), 3) for u in range(12)]
-        with b._cv:
-            b._pending.extend(items)
-        b.submit((0,), 3)
-        for it in items:
-            assert it.done.wait(timeout=10) and it.error is None
+        d0 = b.dispatches
+        futs = [b.submit_async((u % 33,), 3, window=0.5)
+                for u in range(13)]
+        for fut in futs:
+            res, row = fut.result(timeout=10)
+            assert res.render(row, 3)[0] is not None
+        assert b.dispatches == d0 + 1
         assert set(srv._item_programs) == compiled
 
     def test_close_stops_dispatcher_and_gc_releases(self, factors):
@@ -344,7 +343,7 @@ class TestMicroBatching:
         X, Y, seen = factors
         srv = DeviceTopK(X, Y, seen)
         srv.user_topk(0, 3)  # starts the dispatcher
-        assert any(t.name == "pio-microbatch" for t in
+        assert any(t.name == "pio-microbatch-dispatcher" for t in
                    threading.enumerate())
         srv.close()
         time.sleep(0.1)
