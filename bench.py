@@ -33,6 +33,24 @@ N_USERS, N_ITEMS, NNZ = 943, 1682, 100_000
 HEADLINE_METRIC = "als_implicit_ml100k_rank64_events_per_sec"
 
 
+def device_platform() -> str:
+    """The backend every lane in this process measured on ('cpu',
+    'tpu', ...). Stamped into every bench section and the headline
+    so a CPU-smoke artifact can NEVER read like a device number again
+    (BENCH_r05's dead tunnel produced exactly that ambiguity)."""
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _stamp_device(result):
+    """Stamp a bench section dict with the measuring backend (in place,
+    returned for chaining); non-dicts pass through untouched."""
+    if isinstance(result, dict):
+        result.setdefault("device", device_platform())
+    return result
+
+
 def synthetic_ratings(n_users: int, n_items: int, nnz: int, seed: int = 7):
     """Power-law item popularity AND user activity (MovieLens-like)."""
     rng = np.random.default_rng(seed)
@@ -676,7 +694,9 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
                        levels: tuple = (100.0, 250.0, 500.0, 1000.0),
                        duration_sec: float = 3.0, clients: int = 8,
                        slo_p99_ms: float = 250.0,
-                       seed: int = 23) -> dict:
+                       seed: int = 23,
+                       serve_precision: Optional[str] = None,
+                       serve_kernel: Optional[str] = None) -> dict:
     """Closed-loop HTTP load generator against a DEPLOYED query server
     — the PR-10 continuous-batching acceptance bench (ROADMAP item 2:
     sub-10ms p50 at sustained QPS; BENCH_r03's thread-per-request path
@@ -728,9 +748,17 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
 
     rng = np.random.default_rng(seed)
     prior_backend = os.environ.get("PIO_SERVING_BACKEND")
+    prior_precision = os.environ.get("PIO_SERVE_PRECISION")
+    prior_kernel = os.environ.get("PIO_SERVE_KERNEL")
     # the point is the continuous-batching DEVICE path; auto would pick
     # HostTopK for a model this small on CPU
     os.environ["PIO_SERVING_BACKEND"] = "device"
+    # precision/kernel lanes (the int8+fused acceptance lane sets both;
+    # None inherits the ambient policy — the historical behavior)
+    if serve_precision is not None:
+        os.environ["PIO_SERVE_PRECISION"] = serve_precision
+    if serve_kernel is not None:
+        os.environ["PIO_SERVE_KERNEL"] = serve_kernel
     srv = None
     try:
         storage_mod.reset(StorageConfig(
@@ -872,9 +900,11 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
         lanes = [st for st in serving_mod.batcher_stats()
                  if st["dispatches"] > 0]
 
-        return {
+        return _stamp_device({
             "clients": clients,
             "duration_sec_per_level": duration_sec,
+            "serve_precision": serve_precision or "default",
+            "serve_kernel": serve_kernel or "auto",
             "deploy_warmup_sec": round(deploy_sec, 2),
             "levels": sweep,
             "max_sustainable_qps": None if sustainable is None
@@ -898,15 +928,83 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
                      "the FIRST level's (lightest load); "
                      "zero_compile_steady_state is the AOT-ladder "
                      "acceptance gate"),
-        }
+        })
     finally:
         if srv is not None:
             srv.stop()
-        if prior_backend is None:
-            os.environ.pop("PIO_SERVING_BACKEND", None)
-        else:
-            os.environ["PIO_SERVING_BACKEND"] = prior_backend
+        for var, prior in (("PIO_SERVING_BACKEND", prior_backend),
+                           ("PIO_SERVE_PRECISION", prior_precision),
+                           ("PIO_SERVE_KERNEL", prior_kernel)):
+            if prior is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prior
         storage_mod.reset()
+
+
+def serving_quantized_lane_bench(n_users: int = 256, n_items: int = 128,
+                                 rank: int = 8,
+                                 levels: tuple = (100.0, 250.0, 500.0,
+                                                  1000.0),
+                                 duration_sec: float = 3.0,
+                                 clients: int = 8,
+                                 slo_p99_ms: float = 250.0,
+                                 seed: int = 23) -> dict:
+    """The ROADMAP-item-4 acceptance lane: the SAME closed-loop HTTP
+    sweep as ``serving_load_bench``, run twice — the PR-10 bf16 einsum
+    path vs the int8 store + fused gather->score->mask->top-k kernel —
+    plus the arithmetic catalog-capacity story.
+
+    Targets (meaningful only with a live accelerator; CPU runs are a
+    wiring smoke — int8 dequant and interpret-mode Pallas have no CPU
+    win by design, and the headline stays stamped ``device: cpu``):
+
+    - ``qps_ratio_int8_vs_bf16`` >= 2.0 at equal p99 SLO — the fused
+      kernel reads each int8 item row from HBM exactly once per
+      dispatch, vs the bf16 chain's einsum+top_k HBM round trips;
+    - ``catalog_capacity_ratio_vs_fp32`` ~4x / ``..._vs_bf16`` ~2x —
+      servable items per chip scale with bytes-per-row:
+      fp32 = 4R, bf16 = 2R, int8+scale = R + 4;
+    - both lanes keep the zero-steady-state-compile gate green (the
+      int8+fused programs ride the same AOT bucket ladder)."""
+    bf16 = serving_load_bench(
+        n_users=n_users, n_items=n_items, rank=rank, levels=levels,
+        duration_sec=duration_sec, clients=clients,
+        slo_p99_ms=slo_p99_ms, seed=seed,
+        serve_precision="bf16", serve_kernel="xla")
+    int8 = serving_load_bench(
+        n_users=n_users, n_items=n_items, rank=rank, levels=levels,
+        duration_sec=duration_sec, clients=clients,
+        slo_p99_ms=slo_p99_ms, seed=seed,
+        serve_precision="int8", serve_kernel=None)  # auto: fused on TPU
+    qps_bf16 = bf16.get("max_sustainable_qps")
+    qps_int8 = int8.get("max_sustainable_qps")
+    ratio = (round(qps_int8 / qps_bf16, 2)
+             if qps_bf16 and qps_int8 else None)
+    on_accel = device_platform() != "cpu"
+    bytes_fp32, bytes_bf16 = 4.0 * rank, 2.0 * rank
+    bytes_int8 = rank + 4.0  # int8 row + one fp32 scale
+    return _stamp_device({
+        "accelerator": on_accel,
+        "bf16_einsum_lane": bf16,
+        "int8_fused_lane": int8,
+        "qps_ratio_int8_vs_bf16": ratio,
+        "target_qps_ratio": 2.0,
+        "gate_2x_qps": (None if not on_accel or ratio is None
+                        else ratio >= 2.0),
+        "catalog_capacity_ratio_vs_fp32":
+            round(bytes_fp32 / bytes_int8, 2),
+        "catalog_capacity_ratio_vs_bf16":
+            round(bytes_bf16 / bytes_int8, 2),
+        "zero_compile_both_lanes": bool(
+            bf16.get("zero_compile_steady_state")
+            and int8.get("zero_compile_steady_state")),
+        "note": ("int8 store (per-row fp32 scales) + fused Pallas "
+                 "top-k vs the bf16 einsum chain, identical shapes "
+                 "and SLO; the >=2x QPS gate and the ~4x catalog "
+                 "claim are DEVICE targets — a cpu-stamped artifact "
+                 "is a wiring smoke, not a measurement"),
+    })
 
 
 def batchpredict_bench(n_users: int = 2048, n_items: int = 512,
@@ -1781,6 +1879,13 @@ def main(smoke: bool = False) -> None:
         **({"n_users": 96, "n_items": 64, "levels": (50.0, 100.0),
             "duration_sec": 1.0, "clients": 4} if smoke else {}))
 
+    # int8 store + fused top-k kernel vs the bf16 einsum lane (ROADMAP
+    # item 4 acceptance: >=2x QPS + ~4x catalog per chip on device;
+    # CPU smoke proves the wiring and the zero-compile gate only)
+    serving_quant = serving_quantized_lane_bench(
+        **({"n_users": 96, "n_items": 64, "levels": (50.0, 100.0),
+            "duration_sec": 1.0, "clients": 4} if smoke else {}))
+
     # fp32 vs bf16 precision lanes on the headline shape (the fp32 lane
     # stays the headline definition; this reports what bf16 buys)
     precision = als_precision_bench(
@@ -1815,37 +1920,43 @@ def main(smoke: bool = False) -> None:
         "value": round(events_per_sec, 1),
         "unit": "events/s/chip",
         "vs_baseline": round(cpu_epoch / device_epoch, 2),
+        # staleness is self-describing: False means every number above
+        # and below came from a CPU run (dead tunnel / smoke) and must
+        # not be read as a device measurement (BENCH_r05)
+        "accelerator": device_platform() != "cpu",
     }
-    print(json.dumps({
-        **headline,
-        "detail": {
-            "device": str(jax.devices()[0]).strip(),
-            "epoch_sec": round(device_epoch, 4),
-            "cpu_epoch_sec": round(cpu_epoch, 4),
-            "rank": RANK, "iterations": iters,
-            "n_users": n_users, "n_items": n_items,
-            "events_processed": processed,
-            "scale_1m": {
-                "epoch_sec": round(scale_epoch, 4),
-                "events_processed": processed1,
-                "events_per_sec": round(processed1 / scale_epoch, 1),
-                "coverage_of_unique_pairs": 1.0,
-            },
-            "scale_20m": scale20,
-            "scale_100m": scale100,
-            "precision_lanes": precision,
-            "quality": quality,
-            "quality_scale_truncation": quality_scale,
-            "text_classification": text_quality,
-            "serving": serving,
-            "serving_load": serving_load,
-            "instrumentation_overhead": overhead,
-            "tracing_overhead": tracing_overhead,
-            "batchpredict": batchpredict,
-            "chaos_serving": chaos,
-            "foldin_freshness": foldin,
+    detail = {
+        "device": str(jax.devices()[0]).strip(),
+        "epoch_sec": round(device_epoch, 4),
+        "cpu_epoch_sec": round(cpu_epoch, 4),
+        "rank": RANK, "iterations": iters,
+        "n_users": n_users, "n_items": n_items,
+        "events_processed": processed,
+        "scale_1m": {
+            "epoch_sec": round(scale_epoch, 4),
+            "events_processed": processed1,
+            "events_per_sec": round(processed1 / scale_epoch, 1),
+            "coverage_of_unique_pairs": 1.0,
         },
-    }))
+        "scale_20m": scale20,
+        "scale_100m": scale100,
+        "precision_lanes": precision,
+        "quality": quality,
+        "quality_scale_truncation": quality_scale,
+        "text_classification": text_quality,
+        "serving": serving,
+        "serving_load": serving_load,
+        "serving_quantized": serving_quant,
+        "instrumentation_overhead": overhead,
+        "tracing_overhead": tracing_overhead,
+        "batchpredict": batchpredict,
+        "chaos_serving": chaos,
+        "foldin_freshness": foldin,
+    }
+    # every lane carries the backend it measured on
+    for section in detail.values():
+        _stamp_device(section)
+    print(json.dumps({**headline, "detail": detail}))
     # compact repeat LAST so a tail-window capture always retains the
     # headline (round-4 verdict weak #4); same contract keys + the
     # scale figures the judge reads first
@@ -1874,6 +1985,12 @@ def main(smoke: bool = False) -> None:
             serving_load["max_sustainable_qps"],
         "serving_load_zero_compiles":
             serving_load["zero_compile_steady_state"],
+        "serving_int8_qps_ratio_vs_bf16":
+            serving_quant["qps_ratio_int8_vs_bf16"],
+        "serving_int8_catalog_ratio_vs_fp32":
+            serving_quant["catalog_capacity_ratio_vs_fp32"],
+        "serving_int8_zero_compiles":
+            serving_quant["zero_compile_both_lanes"],
         "batchpredict_bulk_qps": batchpredict["bulk_queries_per_sec"],
         "batchpredict_speedup_vs_looped":
             batchpredict["speedup_vs_looped"],

@@ -248,16 +248,24 @@ def run(n_users: int = None, n_items: int = None, nnz: int = None,
 def run_precision_check(n_users: int = None, n_items: int = None,
                         nnz: int = None, seed: int = 7,
                         iterations: int = ITERATIONS) -> dict:
-    """Quality gate for the bf16 training policy (ops/als.py
-    ``ALSParams.precision``): train the SAME ml100k-shaped leave-last-out
-    split under fp32 and bf16 from the same seed and report both
-    Precision@10. The slow-marked test in tests/test_als_precision.py
-    asserts the bf16 drop stays within 0.02 absolute — the hard gate the
-    policy ships behind."""
+    """Quality gate for the precision policies (ops/als.py
+    ``ALSParams.precision`` + the ops/serving.py int8 store): train the
+    SAME ml100k-shaped leave-last-out split under fp32 and bf16 from
+    the same seed and report both Precision@10, then score the fp32
+    factors through the int8 SERVING transform (symmetric per-row
+    absmax quantize -> dequantize — exactly what ``DeviceTopK`` holds
+    under ``PIO_SERVE_PRECISION=int8``; int8 is storage-only, so the
+    serving-side round-trip IS its quality exposure). The slow-marked
+    test in tests/test_als_precision.py asserts both drops stay within
+    0.02 absolute — the hard gate each lane ships behind."""
     import dataclasses as _dc
 
     import bench
     from predictionio_tpu.ops.als import ALSParams, pad_ratings, train_als
+    from predictionio_tpu.ops.quantize import (
+        dequantize_rows_np,
+        quantize_rows_int8_np,
+    )
 
     n_users = n_users if n_users is not None else bench.N_USERS
     n_items = n_items if n_items is not None else bench.N_ITEMS
@@ -274,11 +282,16 @@ def run_precision_check(n_users: int = None, n_items: int = None,
     X16, Y16 = train_als(user_side, item_side,
                          _dc.replace(params, precision="bf16"))
     p16 = precision_at_k(X16, Y16, rows, cols, held)
+    X8 = dequantize_rows_np(quantize_rows_int8_np(np.asarray(X32)))
+    Y8 = dequantize_rows_np(quantize_rows_int8_np(np.asarray(Y32)))
+    p8 = precision_at_k(X8, Y8, rows, cols, held)
     return {
         "check": "precision_policy_quality_gate",
         "fp32_precision_at_10": round(p32, 4),
         "bf16_precision_at_10": round(p16, 4),
         "bf16_drop_abs": round(p32 - p16, 4),
+        "int8_serving_precision_at_10": round(p8, 4),
+        "int8_serving_drop_abs": round(p32 - p8, 4),
         "gate_max_drop_abs": 0.02,
         "holdout_users": len(held),
         "rank": RANK, "iterations": iterations,

@@ -24,6 +24,15 @@ Lifecycle (one daemon thread per deployed engine with ``--foldin on``):
    store via the power-of-two bucket ladder and land in the model's
    ``user_map`` only AFTER the store holds their row.
 
+   Precision interplay: the solve always runs the TRAINING lane
+   (fp32/bf16 per ``ALSParams.precision``) whatever the serving store
+   holds — an int8 store (``PIO_SERVE_PRECISION=int8``) hands the
+   solve a dequantized fp32 item view (``DeviceTopK.item_factors``)
+   and ``patch_users`` re-quantizes the fresh rows with RECOMPUTED
+   per-row absmax scales under the same ``_store_lock`` swap, so a
+   folded row is bit-identical to what quantize-at-load would have
+   produced for the same factors.
+
 Degradation (PR-7 semantics): a failing tail read flips ``stale`` —
 serving continues from the last-good factors and the query server
 stamps responses ``degradedReasons: ["foldin_stale"]``; the next

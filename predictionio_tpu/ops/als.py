@@ -494,17 +494,22 @@ def zero_empty_rows(X, mask):
 PRECISION_MODES = ("fp32", "bf16")
 
 
-def normalize_precision(value: str, source: str) -> str:
-    """Canonicalize a precision string to ``fp32``/``bf16`` (accepting
-    the ``float32``/``bfloat16`` aliases) or raise naming ``source`` —
-    the ONE place the mode whitelist lives, shared by the training
+def normalize_precision(value: str, source: str,
+                        allowed: tuple = PRECISION_MODES) -> str:
+    """Canonicalize a precision string (accepting the ``float32``/
+    ``bfloat16``/``int8``-family aliases) or raise naming ``source`` —
+    the ONE canonicalization shared by the training
     (``PIO_ALS_PRECISION``) and serving (``PIO_SERVE_PRECISION``)
-    resolvers."""
-    mode = {"float32": "fp32", "bfloat16": "bf16"}.get(value, value)
-    if mode not in PRECISION_MODES:
+    resolvers. ``allowed`` is each resolver's whitelist: training
+    accepts only :data:`PRECISION_MODES`; serving extends it with
+    ``int8`` (a storage-only mode that makes no sense as a training
+    accumulate policy, so it must NOT leak into this default)."""
+    mode = {"float32": "fp32", "bfloat16": "bf16",
+            "i8": "int8"}.get(value, value)
+    if mode not in allowed:
         raise ValueError(
             f"{source}={mode!r} is not a known precision mode "
-            f"(expected one of: fp32, bf16)")
+            f"(expected one of: {', '.join(allowed)})")
     return mode
 
 

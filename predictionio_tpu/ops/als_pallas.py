@@ -1,6 +1,6 @@
 """Pallas TPU kernels for ALS.
 
-Two kernels live here:
+Three kernels live here:
 
 1. ``spd_solve`` — batched symmetric positive-definite solve (Cholesky
    factorization + forward/backward triangular substitution fused in
@@ -28,6 +28,23 @@ Two kernels live here:
    assembly. The kernel stays as the exercised foundation for
    DMA-gather work, with interpret-mode tests asserting exact agreement
    with the XLA math.
+
+3. ``fused_gather_score_topk`` — the SERVING kernel (ROADMAP item 4):
+   score matvec + seen-row masking + top-k selection fused into one
+   program. The XLA chain dispatches gather/einsum/mask/top_k as
+   separate HLOs whose ``[B, M]`` score intermediate round-trips HBM
+   between the einsum and the top_k; here each ``[TM, R]`` item-factor
+   tile streams HBM->VMEM exactly once (int8 tiles dequantize against
+   their per-row scales in VMEM — the Tensor Casting co-design axis),
+   is scored on the MXU against the whole query block, masked in
+   registers, and folded into a running per-query top-k held in VMEM
+   across the grid; only the final ``[B, k]`` winners ever reach HBM.
+   A per-tile early-out skips the selection merge whenever the tile's
+   best score cannot beat any query's current k-th — on real catalogs
+   the vast majority of tiles take it. STATUS: the production device
+   path for ``DeviceTopK`` (``PIO_SERVE_KERNEL=xla`` opts out; CPU
+   serves the XLA chain and exercises this kernel in interpret mode,
+   like ``spd_solve``).
 
 Run on CPU (tests) via interpret mode — semantics identical, speed not.
 """
@@ -318,3 +335,220 @@ def solve_side_pallas(Y, cols, weights, mask, lam: float, alpha: float,
     chol = jax.scipy.linalg.cho_factor(A)
     X = jax.scipy.linalg.cho_solve(chol, b)
     return zero_empty_rows(X, mask).astype(Y.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Fused serving kernel: score matvec + seen mask + top-k in one program
+# ---------------------------------------------------------------------------
+
+# item rows per grid step: one f32 tile of the streamed factor table.
+# DeviceTopK pads its item store to this multiple ONCE at construction
+# so dispatches never pay a per-call pad copy.
+TOPK_TILE_M = 128
+
+# query block rounds up to a lane-friendly multiple (scores sit [TM, B]
+# with the batch on the lane dimension)
+_TOPK_B_ALIGN = 8
+
+
+def _topk_select_body(scores, item_ids, run_v, run_i, buf_v, buf_i, K):
+    """Fold one ``[TM, B]`` score tile into the running per-query
+    top-K (``run_v``/``run_i`` [K, B], value-sorted descending).
+
+    Selection is K rounds of argmax-extract over the union buffer
+    ``[K + TM, B]`` — every per-round op is a full-lane-width VPU
+    reduction/select, nothing indexes a lane dynamically. Tie-breaking
+    matches ``jax.lax.top_k`` (lowest index wins): the running entries
+    occupy the LOW buffer positions and earlier tiles hold strictly
+    lower item ids, so ``argmax``'s first-match rule reproduces the
+    XLA chain's ordering exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    TM = scores.shape[0]
+    buf_v[0:K] = run_v[:]
+    buf_i[0:K] = run_i[:]
+    buf_v[K:K + TM] = scores
+    buf_i[K:K + TM] = jnp.broadcast_to(item_ids, scores.shape)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (K + TM, 1), 0)
+
+    def sel(j, _):
+        bv = buf_v[:]
+        m = jnp.max(bv, axis=0)                       # [B]
+        am = jnp.argmax(bv, axis=0).astype(jnp.int32)  # first max
+        one = pos == am[None, :]                      # [K+TM, B]
+        run_v[j] = m
+        run_i[j] = jnp.sum(jnp.where(one, buf_i[:], 0), axis=0)
+        buf_v[:] = jnp.where(one, -jnp.inf, bv)
+        return 0
+
+    jax.lax.fori_loop(0, K, sel, 0)
+
+
+def _fused_topk_body(q_ref, yd_ref, ys_ref, sc_ref, sm_ref,
+                     vals_ref, idx_ref, run_v, run_i, buf_v, buf_i,
+                     *, K, n_items, n_tiles, mask_seen):
+    """One grid step = one ``[TM, R]`` item tile scored, masked, and
+    merged (see module docstring). ``ys_ref`` is None for dense f32/
+    bf16 stores; for int8 stores it carries the tile's per-row fp32
+    scales and the dequantize happens here in VMEM — HBM only ever
+    streams the int8 bytes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+    TM = yd_ref.shape[0]
+
+    @pl.when(t == 0)
+    def _init():
+        run_v[:] = jnp.full(run_v.shape, -jnp.inf, run_v.dtype)
+        run_i[:] = jnp.zeros(run_i.shape, run_i.dtype)
+
+    off = t * TM
+    y = yd_ref[:].astype(jnp.float32)
+    if ys_ref is not None:
+        y = y * ys_ref[:]                             # [TM, R] * [TM, 1]
+    # [TM, B] tile scores on the MXU, fp32 accumulate (HIGHEST matches
+    # the XLA chain's fp32 einsum passes)
+    scores = jax.lax.dot_general(
+        y, q_ref[:], (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    item_ids = jax.lax.broadcasted_iota(jnp.int32, (TM, 1), 0) + off
+    # padded factor rows (index >= n_items) never reach the top-k
+    scores = jnp.where(item_ids < n_items, scores, -jnp.inf)
+    if mask_seen:
+        L = sc_ref.shape[0]
+
+        def mask_step(l, s):
+            hit = (item_ids == sc_ref[l][None, :]) \
+                & (sm_ref[l] > 0)[None, :]
+            return jnp.where(hit, -jnp.inf, s)
+
+        scores = jax.lax.fori_loop(0, L, mask_step, scores)
+
+    # early-out: a tile whose best score cannot beat any query's
+    # current k-th never changes the heap (ties lose to the running
+    # entry, which is always an earlier == lower item id)
+    kth = run_v[K - 1]                                # [B]
+    need = jnp.any(jnp.max(scores, axis=0) > kth)
+
+    @pl.when(need)
+    def _merge():
+        _topk_select_body(scores, item_ids, run_v, run_i, buf_v, buf_i,
+                          K)
+
+    @pl.when(t == n_tiles - 1)
+    def _out():
+        vals_ref[:] = run_v[:]
+        idx_ref[:] = run_i[:]
+
+
+def fused_gather_score_topk(Q, Y, seen_cols, seen_mask, *, k: int,
+                            n_items: int, mask_seen: bool = True,
+                            interpret: Optional[bool] = None,
+                            tile_m: Optional[int] = None):
+    """The fused serving program: ``top_k(mask(Y @ Q^T))`` with the
+    item table streamed HBM->VMEM exactly once.
+
+    ``Q [B, R]`` fp32 query rows (gathered + dequantized user factors,
+    or summed similarity-query rows — the gather lowers into the same
+    jitted program as this call); ``Y`` the item store — a dense
+    ``[M, R]`` fp32/bf16 table or an int8
+    :class:`~predictionio_tpu.ops.quantize.QuantFactors` whose per-row
+    scales dequantize in VMEM; ``seen_cols``/``seen_mask`` ``[L, B]``
+    per-query masked item ids (ignored when ``mask_seen`` is False).
+
+    Returns ``(vals [B, k] f32, idx [B, k] i32)``, rows descending,
+    -inf past the valid candidates — the same contract as the XLA
+    ``top_k`` chain, tie-broken identically (lowest item id first)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from predictionio_tpu.ops.quantize import is_quantized
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    quant = is_quantized(Y)
+    Yd = Y.data if quant else Y
+    M, R = Yd.shape
+    B = Q.shape[0]
+    K = int(k)
+    TM = int(tile_m) if tile_m else TOPK_TILE_M
+    padM = (-M) % TM
+    if padM:  # DeviceTopK pre-pads its store; direct callers pay once
+        Yd = jnp.pad(Yd, ((0, padM), (0, 0)))
+    n_tiles = (M + padM) // TM
+    padB = (-B) % _TOPK_B_ALIGN
+    Bp = B + padB
+    if padB:
+        Q = jnp.pad(Q, ((0, padB), (0, 0)))
+    Qf = Q.astype(jnp.float32)
+
+    in_specs = [
+        pl.BlockSpec((Bp, R), lambda t: (0, 0)),          # Q (resident)
+        pl.BlockSpec((TM, R), lambda t: (t, 0)),          # Y tile stream
+    ]
+    args = [Qf, Yd]
+    if quant:
+        ys = Y.scale.astype(jnp.float32)[:, None]
+        if padM:
+            ys = jnp.pad(ys, ((0, padM), (0, 0)),
+                         constant_values=1.0)
+        in_specs.append(pl.BlockSpec((TM, 1), lambda t: (t, 0)))
+        args.append(ys)
+    if mask_seen:
+        L = seen_cols.shape[0]
+        sc = jnp.asarray(seen_cols, dtype=jnp.int32)
+        sm = jnp.asarray(seen_mask, dtype=jnp.float32)
+        if padB:
+            sc = jnp.pad(sc, ((0, 0), (0, padB)))
+            sm = jnp.pad(sm, ((0, 0), (0, padB)))
+        in_specs += [
+            pl.BlockSpec((L, Bp), lambda t: (0, 0)),
+            pl.BlockSpec((L, Bp), lambda t: (0, 0)),
+        ]
+        args += [sc, sm]
+
+    def kernel(*refs):
+        qr = refs[0]
+        ydr = refs[1]
+        pos = 2
+        ysr = None
+        if quant:
+            ysr = refs[pos]
+            pos += 1
+        scr = smr = None
+        if mask_seen:
+            scr, smr = refs[pos], refs[pos + 1]
+            pos += 2
+        vals_ref, idx_ref, run_v, run_i, buf_v, buf_i = refs[pos:]
+        _fused_topk_body(qr, ydr, ysr, scr, smr, vals_ref, idx_ref,
+                         run_v, run_i, buf_v, buf_i, K=K,
+                         n_items=n_items, n_tiles=n_tiles,
+                         mask_seen=mask_seen)
+
+    vals, idx = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((K, Bp), lambda t: (0, 0)),
+            pl.BlockSpec((K, Bp), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((K, Bp), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K, Bp), jnp.float32),        # running top-k
+            pltpu.VMEM((K, Bp), jnp.int32),
+            pltpu.VMEM((K + TM, Bp), jnp.float32),   # selection union
+            pltpu.VMEM((K + TM, Bp), jnp.int32),
+        ],
+        interpret=bool(interpret),
+    )(*args)
+    return vals.T[:B], idx.T[:B]

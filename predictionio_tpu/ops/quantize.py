@@ -1,0 +1,138 @@
+"""Int8 factor quantization primitives for the serving lane.
+
+The int8 serving store (ROADMAP item 4, the Tensor Casting co-design
+axis from PAPERS.md) holds each factor matrix as ``int8`` values plus
+ONE fp32 scale per row — symmetric absmax quantization:
+
+    scale[i] = max(|row_i|) / 127        (1.0 for all-zero rows)
+    data[i]  = clip(round(row_i / scale[i]), -127, 127)
+
+and dequantization is ``data[i] * scale[i]`` — exact zeros stay exact
+zeros, the row's largest-magnitude entry round-trips exactly, and every
+other entry lands within ``scale/2``. Row granularity matters: factor
+rows span orders of magnitude across a catalog's popularity power law,
+and a single tensor-wide scale would crush the tail rows to zero.
+
+Everything here is plain jnp (jit-friendly, sharding-preserving: the
+per-row reduce and the elementwise ops keep a row-sharded layout) and
+accepts numpy or jax inputs. The serving store, the fold-in patch path
+(``DeviceTopK.patch_users`` re-quantizes fresh rows with recomputed
+scales), and ``HostTopK``'s int8 acceptance all share these four
+functions — the differential tests in ``tests/test_quantize.py`` pin
+them in isolation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import numpy as np
+
+INT8_QMAX = 127.0
+
+
+class QuantFactors(NamedTuple):
+    """An int8 factor table with per-row fp32 scales.
+
+    A NamedTuple so jit/AOT treat it as a pytree (the serving programs
+    take the store as an argument), with array-like ``shape``/``dtype``
+    conveniences so store bookkeeping (capacity, signatures, sharding
+    checks) reads the same for quantized and dense stores."""
+
+    data: Any   # int8 [N, R]
+    scale: Any  # float32 [N]
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def sharding(self):
+        # propagate AttributeError for host numpy data so
+        # ``hasattr(store, "sharding")`` keeps meaning "device-resident"
+        return self.data.sharding
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.data.shape)
+                   + 4 * np.prod(np.shape(self.scale)))
+
+
+def is_quantized(factors: Any) -> bool:
+    return isinstance(factors, QuantFactors)
+
+
+def quantize_rows_int8(factors) -> QuantFactors:
+    """Symmetric per-row absmax quantization to int8 (round-half-even,
+    matching numpy's ``np.round`` so host- and device-side quantization
+    of the same rows agree bitwise). All-zero rows take scale 1.0 so
+    dequantization is division-free-safe and yields exact zeros. A
+    bf16 input (re-quantizing a bf16 serving store) casts through fp32
+    first — the scale computation must not square bf16 rounding."""
+    import jax.numpy as jnp
+
+    f = jnp.asarray(factors)
+    if f.ndim != 2:
+        raise ValueError(
+            f"quantize_rows_int8: expected [N, R] factors, got "
+            f"shape {tuple(f.shape)}")
+    f = f.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(f), axis=1)
+    scale = jnp.where(absmax > 0, absmax / INT8_QMAX, 1.0)
+    q = jnp.clip(jnp.round(f / scale[:, None]), -INT8_QMAX, INT8_QMAX)
+    return QuantFactors(q.astype(jnp.int8), scale.astype(jnp.float32))
+
+
+def dequantize_rows(quant: QuantFactors):
+    """fp32 dense view of a quantized table (``data * scale`` per row).
+    Inside a jitted scoring program XLA fuses this into the consuming
+    dot's operand read; materialized only where a dense table is truly
+    needed (host serving, the fold-in solve's fixed item side)."""
+    import jax.numpy as jnp
+
+    return quant.data.astype(jnp.float32) * quant.scale[:, None]
+
+
+def dequantize_rows_np(quant: QuantFactors) -> np.ndarray:
+    """Host-side dequantization (numpy in, numpy out) for HostTopK."""
+    data = np.asarray(quant.data)
+    scale = np.asarray(quant.scale, dtype=np.float32)
+    return data.astype(np.float32) * scale[:, None]
+
+
+def quantize_rows_int8_np(factors: np.ndarray) -> QuantFactors:
+    """Numpy twin of :func:`quantize_rows_int8` (same rounding rule;
+    the differential test asserts bitwise agreement) for callers that
+    must not touch the device — e.g. packing a model artifact."""
+    f = np.asarray(factors, dtype=np.float32)
+    if f.ndim != 2:
+        raise ValueError(
+            f"quantize_rows_int8_np: expected [N, R] factors, got "
+            f"shape {f.shape}")
+    absmax = np.max(np.abs(f), axis=1)
+    scale = np.where(absmax > 0, absmax / INT8_QMAX, 1.0) \
+        .astype(np.float32)
+    q = np.clip(np.round(f / scale[:, None]), -INT8_QMAX, INT8_QMAX)
+    return QuantFactors(q.astype(np.int8), scale)
+
+
+def quantization_error_bound(quant: QuantFactors) -> np.ndarray:
+    """Per-row worst-case absolute reconstruction error: half an int8
+    step, ``scale/2`` (the round-trip tests assert against this)."""
+    return np.asarray(quant.scale, dtype=np.float32) / 2.0
+
+
+__all__ = [
+    "INT8_QMAX",
+    "QuantFactors",
+    "dequantize_rows",
+    "dequantize_rows_np",
+    "is_quantized",
+    "quantization_error_bound",
+    "quantize_rows_int8",
+    "quantize_rows_int8_np",
+]

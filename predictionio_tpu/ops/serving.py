@@ -52,10 +52,17 @@ from predictionio_tpu.ops.aot import AOTCache, lower_compile
 from predictionio_tpu.utils.tracing import span as _trace_span
 
 
+# the serving whitelist extends the training one with int8: a
+# storage-only mode (per-row-scaled int8 factor tables, fp32 score
+# accumulation) that has no training-accumulate meaning
+SERVE_PRECISION_MODES = ("fp32", "bf16", "int8")
+
+
 def _serve_precision_explicit() -> Optional[str]:
     """The operator's explicit ``PIO_SERVE_PRECISION`` choice, or None
-    when unset. Unknown values raise (one shared whitelist with the
-    training-side ``PIO_ALS_PRECISION`` policy)."""
+    when unset. Unknown values raise (one shared canonicalizer with the
+    training-side ``PIO_ALS_PRECISION`` policy; serving additionally
+    accepts ``int8``)."""
     import os
 
     mode = os.environ.get("PIO_SERVE_PRECISION", "").strip().lower()
@@ -63,7 +70,8 @@ def _serve_precision_explicit() -> Optional[str]:
         return None
     from predictionio_tpu.ops.als import normalize_precision
 
-    return normalize_precision(mode, "PIO_SERVE_PRECISION")
+    return normalize_precision(mode, "PIO_SERVE_PRECISION",
+                               allowed=SERVE_PRECISION_MODES)
 
 
 def _default_serve_precision() -> str:
@@ -97,6 +105,33 @@ def _is_bf16(arr) -> bool:
     return getattr(getattr(arr, "dtype", None), "name", "") == "bfloat16"
 
 
+def _serve_kernel_mode() -> str:
+    """Which program family serves device top-k: the fused Pallas
+    kernel (``ops/als_pallas.py::fused_gather_score_topk`` — gather,
+    score matvec, seen-mask, and top-k selection in ONE program that
+    streams each item-factor tile HBM->VMEM exactly once) or the
+    historical XLA gather/einsum/mask/top-k chain.
+
+    ``PIO_SERVE_KERNEL``: ``fused`` forces the kernel (interpret mode
+    off-TPU — the tests' lane), ``xla`` opts out, unset/``auto`` picks
+    fused on TPU and XLA elsewhere (CPU has no Mosaic; interpret mode
+    is a correctness tool, not a fast path). Unknown values raise."""
+    import os
+
+    import jax
+
+    val = os.environ.get("PIO_SERVE_KERNEL", "").strip().lower()
+    if val in ("", "auto"):
+        return "fused" if jax.default_backend() == "tpu" else "xla"
+    if val in ("fused", "pallas"):
+        return "fused"
+    if val == "xla":
+        return "xla"
+    raise ValueError(
+        f"PIO_SERVE_KERNEL={val!r} is not a known serving kernel "
+        "(expected one of: auto, fused, xla)")
+
+
 def foldin_enabled() -> bool:
     """``PIO_FOLDIN`` — set by ``pio deploy --foldin on`` (and readable
     directly by embedders): the deployed server runs the online fold-in
@@ -109,20 +144,48 @@ def foldin_enabled() -> bool:
         "1", "on", "true", "yes")
 
 
-def _score_einsum(subscripts: str, *operands):
-    """Scoring matmul under the serving precision policy: fp32 factors
-    keep the historical full-precision MXU passes; bf16 factors feed the
-    MXU natively with an fp32 accumulator (``preferred_element_type``) —
-    either way the result is float32 (``_pack`` and the -inf masking
+def _score_einsum(subscripts: str, *operands, mode: str):
+    """Scoring matmul under the serving precision policy. ``mode`` is
+    the STORE'S declared precision, threaded explicitly from the server
+    that owns the factors — never sniffed from operand dtypes (a mixed
+    fp32/bf16 operand pair used to silently steer the accumulate path;
+    the regression test in tests/test_serving_device.py pins the fix):
+
+    - ``fp32``: the historical full-precision MXU passes
+      (``Precision.HIGHEST``);
+    - ``bf16``: operands feed the MXU natively with an fp32 accumulator
+      (``preferred_element_type``);
+    - ``int8``: :class:`~predictionio_tpu.ops.quantize.QuantFactors`
+      operands dequantize (``data * per-row scale``) INTO the fp32
+      accumulate — XLA fuses the dequant into the dot's operand read,
+      so HBM still streams int8 bytes.
+
+    Either way the result is float32 (``_pack`` and the -inf masking
     depend on it)."""
     import jax
     import jax.numpy as jnp
 
-    if any(_is_bf16(op) for op in operands):
+    from predictionio_tpu.ops.quantize import dequantize_rows, is_quantized
+
+    if mode == "int8":
+        ops = [dequantize_rows(op) if is_quantized(op) else
+               jnp.asarray(op).astype(jnp.float32) for op in operands]
+        # HIGHEST: the dequantized operands are fp32 and must stay on
+        # full-precision MXU passes (TPU would otherwise bf16-truncate
+        # them, stacking truncation on top of the quantization error —
+        # and diverging from the fused kernel's HIGHEST dot)
+        return jnp.einsum(subscripts, *ops,
+                          precision=jax.lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32)
+    if mode == "bf16":
         return jnp.einsum(subscripts, *operands,
                           preferred_element_type=jnp.float32)
-    return jnp.einsum(subscripts, *operands,
-                      precision=jax.lax.Precision.HIGHEST)
+    if mode == "fp32":
+        return jnp.einsum(subscripts, *operands,
+                          precision=jax.lax.Precision.HIGHEST)
+    raise ValueError(f"_score_einsum: unknown serving precision mode "
+                     f"{mode!r} (expected one of: "
+                     f"{', '.join(SERVE_PRECISION_MODES)})")
 
 
 def seen_tables(seen: Dict[int, np.ndarray], n_rows: int,
@@ -168,15 +231,68 @@ def _unpack(out: np.ndarray, kb: int) -> Tuple[np.ndarray, np.ndarray]:
     return out[..., kb:].view(np.int32), out[..., :kb]
 
 
+def _take_user_row_f32(X, uid, *, mode: str):
+    """One user's factor row as fp32, whatever the store holds: int8
+    rows dequantize with their own scale at gather time (a [R] row —
+    the int8 bandwidth policy is about the ITEM table stream, not this
+    single row)."""
+    import jax
+
+    from predictionio_tpu.ops.quantize import is_quantized
+
+    if mode == "int8" and is_quantized(X):
+        d = jax.lax.dynamic_index_in_dim(X.data, uid, 0, keepdims=False)
+        s = jax.lax.dynamic_index_in_dim(X.scale, uid, 0, keepdims=False)
+        return d.astype("float32") * s
+    return jax.lax.dynamic_index_in_dim(X, uid, axis=0, keepdims=False)
+
+
+def _gather_rows_f32(factors, idx, *, mode: str):
+    """Factor rows gathered by index (any index shape) as fp32 — the
+    ONE take-and-dequantize used by every fused-program gather; int8
+    rows dequantize with their own per-row scales."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops.quantize import is_quantized
+
+    if mode == "int8" and is_quantized(factors):
+        return jnp.take(factors.data, idx, axis=0).astype(jnp.float32) \
+            * jnp.take(factors.scale, idx, axis=0)[..., None]
+    return jnp.take(factors, idx, axis=0).astype(jnp.float32)
+
+
+def _pad_item_rows_for_kernel(Y):
+    """Item table padded (zeros, scale 1) to the fused kernel's tile
+    multiple — one-time at store construction, so dispatches never pay
+    a per-call copy. Pad rows live past ``n_items`` and are -inf-masked
+    on device exactly like sharded-training padding."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops import als_pallas
+    from predictionio_tpu.ops.quantize import QuantFactors, is_quantized
+
+    m = int(Y.shape[0])
+    pad = (-m) % als_pallas.TOPK_TILE_M
+    if not pad:
+        return Y
+    if is_quantized(Y):
+        return QuantFactors(
+            jnp.concatenate(
+                [Y.data, jnp.zeros((pad, Y.data.shape[1]), Y.data.dtype)]),
+            jnp.concatenate([Y.scale, jnp.ones((pad,), Y.scale.dtype)]))
+    return jnp.concatenate([Y, jnp.zeros((pad, Y.shape[1]), Y.dtype)])
+
+
 def _user_topk(X, Y, seen_cols, seen_mask, uid, *, k: int, mask_seen: bool,
-               n_items: int):
+               n_items: int, mode: str = "fp32"):
     """scores = Y @ X[uid], seen + padding masked to -inf, device top_k,
-    packed into one flat output buffer."""
+    packed into one flat output buffer. ``mode`` is the store's declared
+    precision, static per compiled program."""
     import jax
     import jax.numpy as jnp
 
-    u = jax.lax.dynamic_index_in_dim(X, uid, axis=0, keepdims=False)
-    scores = _score_einsum("mr,r->m", Y, u)
+    u = _take_user_row_f32(X, uid, mode=mode)
+    scores = _score_einsum("mr,r->m", Y, u, mode=mode)
     if mask_seen:
         sc = jax.lax.dynamic_index_in_dim(seen_cols, uid, 0, keepdims=False)
         sm = jax.lax.dynamic_index_in_dim(seen_mask, uid, 0, keepdims=False)
@@ -186,18 +302,33 @@ def _user_topk(X, Y, seen_cols, seen_mask, uid, *, k: int, mask_seen: bool,
     return _pack(*jax.lax.top_k(_mask_padding(scores, n_items), k))
 
 
-def _items_topk(Yn, idx, idx_mask, *, k: int, n_items: int):
+def _gather_query_rows_f32(Yn, idx, idx_mask, *, mode: str):
+    """The masked query-item rows for a similarity query, in the dtype
+    the scoring einsum wants: bf16 stays bf16 (an fp32 mask would
+    silently promote it off the native-bf16 MXU path), int8 rows
+    dequantize to fp32 (a [B, R] gather — tiny next to the item
+    stream)."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops.quantize import is_quantized
+
+    if mode == "int8" and is_quantized(Yn):
+        qf = jnp.take(Yn.data, idx, axis=0).astype(jnp.float32) \
+            * jnp.take(Yn.scale, idx, axis=0)[:, None]
+        return qf * idx_mask[:, None]
+    return jnp.take(Yn, idx, axis=0) * idx_mask[:, None].astype(Yn.dtype)
+
+
+def _items_topk(Yn, idx, idx_mask, *, k: int, n_items: int,
+                mode: str = "fp32"):
     """Summed-cosine item-similarity scores against a padded query-item
     bucket, device top_k (cosine semantics of ALSAlgorithm.scala:121-135).
     ``Yn`` is the row-normalized item matrix (precomputed once)."""
     import jax
     import jax.numpy as jnp
 
-    qf = jnp.take(Yn, idx, axis=0)                    # [B, R]
-    # mask in the factor dtype: an fp32 mask would silently promote a
-    # bf16 qf off the native-bf16 MXU path
-    qm = qf * idx_mask[:, None].astype(Yn.dtype)
-    scores = _score_einsum("mr,br->m", Yn, qm)
+    qm = _gather_query_rows_f32(Yn, idx, idx_mask, mode=mode)
+    scores = _score_einsum("mr,br->m", Yn, qm, mode=mode)
     # the query items themselves never recommend (mask to -inf)
     scores = scores.at[idx].add(
         jnp.where(idx_mask > 0, -jnp.inf, 0.0), mode="drop")
@@ -207,9 +338,28 @@ def _items_topk(Yn, idx, idx_mask, *, k: int, n_items: int):
 def _normalize_rows(Y):
     """Row-normalize, computing the norms in fp32 regardless of the
     factor storage dtype (a bf16 norm would square bf16 values); the
-    result keeps Y's dtype so bf16 stores stay half-width in HBM."""
+    result keeps Y's dtype so bf16 stores stay half-width in HBM. A
+    quantized store re-quantizes the normalized rows — unit-norm rows
+    have per-row absmax <= 1, so the recomputed scales keep full int8
+    resolution."""
     import jax
     import jax.numpy as jnp
+
+    from predictionio_tpu.ops.quantize import (
+        dequantize_rows,
+        is_quantized,
+        quantize_rows_int8,
+    )
+
+    if is_quantized(Y):
+        @jax.jit
+        def norm_q(Yq):
+            Yf = dequantize_rows(Yq)
+            Yn = Yf / jnp.maximum(
+                jnp.linalg.norm(Yf, axis=1, keepdims=True), 1e-12)
+            return quantize_rows_int8(Yn)
+
+        return norm_q(Y)
 
     @jax.jit
     def norm(Y):
@@ -250,6 +400,19 @@ class HostTopK:
                  seen: Optional[Dict[int, np.ndarray]] = None,
                  n_users: Optional[int] = None,
                  n_items: Optional[int] = None):
+        from predictionio_tpu.ops.quantize import (
+            dequantize_rows_np,
+            is_quantized,
+        )
+
+        # an int8+scales store (a quantized model artifact, or a
+        # device store gathered to host) serves on host in fp32 — numpy
+        # has no int8 BLAS, and at host-servable sizes the memory
+        # quartering buys nothing (mirror of the bf16 rule below)
+        if is_quantized(user_factors):
+            user_factors = dequantize_rows_np(user_factors)
+        if is_quantized(item_factors):
+            item_factors = dequantize_rows_np(item_factors)
         self._X = np.asarray(user_factors)
         self._Y = np.asarray(item_factors)
         if _is_bf16(self._X):
@@ -338,12 +501,14 @@ def choose_server(user_factors, item_factors,
 
     Device stores default to bfloat16 factors on accelerators (fp32
     score accumulation; ``PIO_SERVE_PRECISION=fp32`` opts out). An
-    EXPLICIT ``PIO_SERVE_PRECISION=bf16`` additionally forces the
-    device backend in auto mode — the policy is an HBM policy and
-    means nothing on host — and conflicts loudly with an explicit
+    EXPLICIT ``PIO_SERVE_PRECISION=bf16`` or ``int8`` additionally
+    forces the device backend in auto mode — both are HBM policies
+    (bf16 halves, int8+per-row-scales quarters the factor stream) and
+    mean nothing on host — and conflicts loudly with an explicit
     ``host`` backend. The backend-aware default never steers backend
     selection: small host-resident models still serve via HostTopK
-    (always fp32).
+    (always fp32; it ACCEPTS an int8+scales store by dequantizing,
+    but never creates one).
 
     ``PIO_FOLDIN`` (set by ``pio deploy --foldin on``) likewise forces
     the device backend: online fold-in patches the live factor store in
@@ -355,10 +520,14 @@ def choose_server(user_factors, item_factors,
     factors live only in HBM and always serve via DeviceTopK."""
     import os
 
+    from predictionio_tpu.ops.quantize import is_quantized
+
     backend = os.environ.get("PIO_SERVING_BACKEND", "auto").lower()
-    # only the operator's EXPLICIT bf16 steers backend selection; the
-    # accelerator default applies silently once a device store exists
-    bf16_serve = _serve_precision_explicit() == "bf16"
+    # only the operator's EXPLICIT bf16/int8 steers backend selection;
+    # the accelerator default applies silently once a device store
+    # exists
+    explicit = _serve_precision_explicit()
+    hbm_policy_serve = explicit in ("bf16", "int8")
     foldin = foldin_enabled()
     host_capable = not (hasattr(user_factors, "sharding")
                         or hasattr(item_factors, "sharding"))
@@ -367,11 +536,11 @@ def choose_server(user_factors, item_factors,
             raise ValueError(
                 "PIO_SERVING_BACKEND=host but the factors are "
                 "device-resident jax Arrays")
-        if bf16_serve:
+        if hbm_policy_serve:
             raise ValueError(
-                "PIO_SERVE_PRECISION=bf16 conflicts with "
-                "PIO_SERVING_BACKEND=host: the bf16 store is a device "
-                "(HBM) policy; host serving is always fp32")
+                f"PIO_SERVE_PRECISION={explicit} conflicts with "
+                "PIO_SERVING_BACKEND=host: the quantized/bf16 store is "
+                "a device (HBM) policy; host serving is always fp32")
         if foldin:
             raise ValueError(
                 "PIO_FOLDIN=on conflicts with PIO_SERVING_BACKEND=host: "
@@ -379,11 +548,16 @@ def choose_server(user_factors, item_factors,
                 "(DeviceTopK.patch_users); host serving has no updatable "
                 "store")
         cls = HostTopK
-    elif backend == "device" or bf16_serve or foldin:
+    elif backend == "device" or hbm_policy_serve or foldin:
         cls = DeviceTopK
     else:
-        small = (np.asarray(item_factors).size <= HOST_SERVE_MAX_ELEMS
-                 if host_capable else False)
+        if host_capable:
+            elems = (int(np.prod(item_factors.shape))
+                     if is_quantized(item_factors)
+                     else np.asarray(item_factors).size)
+            small = elems <= HOST_SERVE_MAX_ELEMS
+        else:
+            small = False
         cls = HostTopK if host_capable and small else DeviceTopK
     return cls(user_factors, item_factors, seen,
                n_users=n_users, n_items=n_items)
@@ -923,6 +1097,30 @@ def _scatter_rows(table, idx, rows):
     return fn(table, jnp.asarray(idx), jnp.asarray(rows))
 
 
+_quant_scatter_jits: Dict[bool, object] = {}
+
+
+def _scatter_quant_rows(data, scale, idx, row_d, row_s):
+    """Int8 data rows and their per-row scales scattered in ONE
+    dispatch (donating both on accelerators): a quantized row is only
+    meaningful WITH its scale, so the pair must land or fail together
+    — same discipline as :func:`_scatter_seen`."""
+    import jax
+
+    donate = jax.default_backend() != "cpu"
+    fn = _quant_scatter_jits.get(donate)
+    if fn is None:
+        fn = jax.jit(
+            lambda d, s, i, rd, rs: (d.at[i].set(rd.astype(d.dtype)),
+                                     s.at[i].set(rs.astype(s.dtype))),
+            donate_argnums=(0, 1) if donate else ())
+        _quant_scatter_jits[donate] = fn
+    import jax.numpy as jnp
+
+    return fn(data, scale, jnp.asarray(idx), jnp.asarray(row_d),
+              jnp.asarray(row_s))
+
+
 _seen_scatter_jits: Dict[bool, object] = {}
 
 
@@ -959,6 +1157,18 @@ class DeviceTopK:
     dispatch (see :class:`BatchDispatcher`); set ``microbatch=False`` or
     ``PIO_SERVING_MICROBATCH=0`` to dispatch per call.
 
+    The factor store's precision is the PR-5 policy extended one stop
+    down the Tensor Casting axis: fp32, bf16 (the accelerator default),
+    or ``PIO_SERVE_PRECISION=int8`` — int8 rows with per-row fp32
+    absmax scales (:mod:`~predictionio_tpu.ops.quantize`), ~4x less
+    HBM than fp32 for the model AND the per-dispatch item stream,
+    scores always accumulated + returned fp32. On TPU the top-k itself
+    runs as ONE fused Pallas program (gather -> score -> seen-mask ->
+    top-k, item tiles streamed HBM->VMEM exactly once —
+    ``ops/als_pallas.py::fused_gather_score_topk``); ``PIO_SERVE_KERNEL
+    =xla`` opts back into the XLA chain, which CPU and mesh-sharded
+    stores use always.
+
     The user factor store is LIVE-PATCHABLE (:meth:`patch_users`, the
     online fold-in write path): every device dispatch snapshots the
     store references under ``_store_lock``, and a patch swaps all of
@@ -977,6 +1187,12 @@ class DeviceTopK:
 
         import jax.numpy as jnp
 
+        from predictionio_tpu.ops.quantize import (
+            QuantFactors,
+            is_quantized,
+            quantize_rows_int8,
+        )
+
         self._store_lock = threading.RLock()
         if microbatch is None:
             microbatch = os.environ.get(
@@ -994,11 +1210,26 @@ class DeviceTopK:
                 "pio-microbatch-items", max_batch=64,
                 dispatch_fn=_dispatch_item_group)
 
-        self._X = (user_factors if hasattr(user_factors, "sharding")
-                   else jnp.asarray(user_factors))
-        self._Y = (item_factors if hasattr(item_factors, "sharding")
-                   else jnp.asarray(item_factors))
-        if _serve_precision_mode() == "bf16":
+        def to_device(f):
+            if is_quantized(f):
+                return QuantFactors(
+                    f.data if hasattr(f.data, "sharding")
+                    else jnp.asarray(f.data),
+                    jnp.asarray(f.scale).astype(jnp.float32))
+            return f if hasattr(f, "sharding") else jnp.asarray(f)
+
+        # the store's declared precision, static for this server's
+        # lifetime: every compiled program threads it explicitly into
+        # _score_einsum (never sniffed from operand dtypes). An input
+        # that is ALREADY int8+scales forces int8 — the store is what
+        # it is, whatever the env says.
+        mode = _serve_precision_mode()
+        if is_quantized(user_factors) or is_quantized(item_factors):
+            mode = "int8"
+        self._mode = mode
+        self._X = to_device(user_factors)
+        self._Y = to_device(item_factors)
+        if mode == "bf16":
             # opt-in bf16 factor store: halves the HBM the model holds
             # AND the bytes every scoring matmul streams; the cast
             # preserves an existing mesh sharding (elementwise program).
@@ -1007,12 +1238,40 @@ class DeviceTopK:
                 self._X = self._X.astype(jnp.bfloat16)
             if not _is_bf16(self._Y):
                 self._Y = self._Y.astype(jnp.bfloat16)
+        elif mode == "int8":
+            # int8 store with per-row fp32 scales (symmetric absmax):
+            # ~4x less HBM than fp32, ~2x less than bf16, for the model
+            # AND the per-dispatch item stream; scores still accumulate
+            # + return fp32. Row-wise ops preserve an existing row
+            # sharding; the cast is one-time at load.
+            if not is_quantized(self._X):
+                self._X = quantize_rows_int8(self._X)
+            if not is_quantized(self._Y):
+                self._Y = quantize_rows_int8(self._Y)
+        # which top-k program family serves: the fused Pallas kernel
+        # (one program: gather -> score -> mask -> top-k, item tiles
+        # stream HBM->VMEM exactly once) or the XLA chain. The fused
+        # kernel is single-chip — mesh-sharded stores keep the XLA
+        # chain, whose matmul XLA partitions across the mesh.
+        self._kernel = _serve_kernel_mode()
+        if self._kernel == "fused":
+            sh = getattr(self._X, "sharding", None)
+            if sh is not None and getattr(
+                    getattr(sh, "mesh", None), "devices",
+                    np.empty(1)).size > 1:
+                self._kernel = "xla"
         # factor tables may be padded (sharded training pads rows);
         # n_users/n_items bound the valid index range
         self.n_users = int(n_users if n_users is not None
                            else self._X.shape[0])
         self.n_items = int(n_items if n_items is not None
                            else self._Y.shape[0])
+        if self._kernel == "fused":
+            # pad the item table ONCE to the kernel's tile multiple so
+            # no dispatch ever pays a per-call copy; padded rows sit
+            # past n_items and are masked on device like any training
+            # padding
+            self._Y = _pad_item_rows_for_kernel(self._Y)
         self._mask_seen = bool(seen)
         if self._mask_seen:
             cols, mask = seen_tables(seen, self._X.shape[0])
@@ -1024,6 +1283,9 @@ class DeviceTopK:
         self._user_programs: Dict[int, object] = {}
         self._batch_programs: Dict[Tuple[int, int], object] = {}
         self._item_programs: Dict[object, object] = {}
+        # fused-kernel jit programs are shape-polymorphic over the uid
+        # bucket, so the user lanes cache per k-bucket only
+        self._fused_programs: Dict[object, object] = {}
         # AOT-compiled ladder executables (warmup/precompile): keyed by
         # (store signature, program shape) so a store reshaped by
         # fold-in growth can never hit a stale executable — the jit
@@ -1046,29 +1308,114 @@ class DeviceTopK:
 
     # -- compilation ------------------------------------------------------
 
+    def _fused_user_program(self, kb: int):
+        """The fused-kernel serving program for one k bucket: gather,
+        dequant, seen-row gather, and the Pallas score+mask+top-k
+        kernel lower into ONE program. Shape-polymorphic over the uid
+        bucket (scalar uid included) — jit re-specializes per shape and
+        the AOT ladder pins each bucket's executable."""
+        prog = self._fused_programs.get(("u", kb))
+        if prog is None:
+            import jax
+            import jax.numpy as jnp
+
+            from predictionio_tpu.ops.als_pallas import (
+                fused_gather_score_topk,
+            )
+
+            mode, mask_seen, n_items = (self._mode, self._mask_seen,
+                                        self.n_items)
+            interpret = jax.default_backend() != "tpu"
+
+            @jax.jit
+            def prog(X, Y, sc, sm, uids):
+                scalar = jnp.ndim(uids) == 0
+                u = uids[None] if scalar else uids
+                Q = _gather_rows_f32(X, u, mode=mode)
+                scg = jnp.take(sc, u, axis=0).T  # [L, B]
+                smg = jnp.take(sm, u, axis=0).T
+                vals, idx = fused_gather_score_topk(
+                    Q, Y, scg, smg, k=kb, n_items=n_items,
+                    mask_seen=mask_seen, interpret=interpret)
+                packed = _pack(vals, idx)
+                return packed[0] if scalar else packed
+
+            self._fused_programs[("u", kb)] = prog
+        return prog
+
+    def _fused_items_program(self, kb: int):
+        """Fused-kernel item-similarity program: the [G, B] query
+        bucket reduces to one summed query row per group, then the SAME
+        kernel scores it against every item tile with the query items
+        masked (their idx/mask table plays the seen-table role)."""
+        prog = self._fused_programs.get(("i", kb))
+        if prog is None:
+            import jax
+            import jax.numpy as jnp
+
+            from predictionio_tpu.ops.als_pallas import (
+                fused_gather_score_topk,
+            )
+
+            mode, n_items = self._mode, self.n_items
+            interpret = jax.default_backend() != "tpu"
+
+            @jax.jit
+            def prog(Yn, idxs, masks):
+                qf = _gather_rows_f32(Yn, idxs, mode=mode)  # [G, B, R]
+                Q = (qf * masks[..., None]).sum(axis=1)      # [G, R]
+                vals, idx = fused_gather_score_topk(
+                    Q, Yn, idxs.T, masks.T, k=kb, n_items=n_items,
+                    mask_seen=True, interpret=interpret)
+                return _pack(vals, idx)
+
+            self._fused_programs[("i", kb)] = prog
+        return prog
+
     def _user_program(self, k: int):
+        if self._kernel == "fused":
+            return self._fused_user_program(k)
         import jax
 
         prog = self._user_programs.get(k)
         if prog is None:
             prog = jax.jit(partial(_user_topk, k=k,
                                    mask_seen=self._mask_seen,
-                                   n_items=self.n_items))
+                                   n_items=self.n_items,
+                                   mode=self._mode))
             self._user_programs[k] = prog
         return prog
 
     def _batch_program(self, k: int, b: int):
         """vmap of the per-user program over a [b] uid vector: b queries,
         one dispatch, one packed [b, 2k] fetch."""
+        if self._kernel == "fused":
+            return self._fused_user_program(k)
         import jax
 
         prog = self._batch_programs.get((k, b))
         if prog is None:
             prog = jax.jit(jax.vmap(
                 partial(_user_topk, k=k, mask_seen=self._mask_seen,
-                        n_items=self.n_items),
+                        n_items=self.n_items, mode=self._mode),
                 in_axes=(None, None, None, None, 0)))
             self._batch_programs[(k, b)] = prog
+        return prog
+
+    def _items_program(self, kb: int, B: int, G: int):
+        """vmap of the item-similarity program over a [G, B] query
+        bucket (or its fused equivalent)."""
+        if self._kernel == "fused":
+            return self._fused_items_program(kb)
+        import jax
+
+        prog = self._item_programs.get((kb, B, G))
+        if prog is None:
+            prog = jax.jit(jax.vmap(
+                partial(_items_topk, k=kb, n_items=self.n_items,
+                        mode=self._mode),
+                in_axes=(None, 0, 0)))
+            self._item_programs[(kb, B, G)] = prog
         return prog
 
     def _normalized_items(self):
@@ -1086,9 +1433,15 @@ class DeviceTopK:
         under it, so a store reshaped by fold-in growth misses cleanly
         (and takes the jit fallback) instead of crashing a stale
         executable. Caller holds ``_store_lock``."""
-        return (tuple(self._X.shape), str(self._X.dtype),
-                tuple(self._Y.shape), str(self._Y.dtype),
-                tuple(self._seen_cols.shape))
+        from predictionio_tpu.ops.quantize import is_quantized
+
+        def fsig(f):
+            if is_quantized(f):
+                return ("int8q", tuple(f.data.shape), str(f.data.dtype))
+            return (tuple(f.shape), str(f.dtype))
+
+        return (fsig(self._X), fsig(self._Y),
+                tuple(self._seen_cols.shape), self._mode, self._kernel)
 
     def _aot_get_locked(self, entry: Tuple):
         return self._aot_programs.get((self._store_sig_locked(), entry))
@@ -1162,27 +1515,23 @@ class DeviceTopK:
             if any(e[0] == "items" for e in plan) else None
 
         def build(entry: Tuple):
+            # the SAME builders the dispatch paths use (XLA chain or
+            # fused kernel per self._kernel), so AOT executables and
+            # jit fallbacks can never encode different programs
             kind = entry[0]
             if kind == "user":
-                fn = jax.jit(partial(_user_topk, k=entry[1],
-                                     mask_seen=self._mask_seen,
-                                     n_items=self.n_items))
+                fn = self._user_program(entry[1])
                 return entry, lower_compile(
                     fn, X, Y, sc, sm,
                     jax.ShapeDtypeStruct((), jnp.int32))
             if kind == "users":
                 _, kb, bb = entry
-                fn = jax.jit(jax.vmap(
-                    partial(_user_topk, k=kb, mask_seen=self._mask_seen,
-                            n_items=self.n_items),
-                    in_axes=(None, None, None, None, 0)))
+                fn = self._batch_program(kb, bb)
                 return entry, lower_compile(
                     fn, X, Y, sc, sm,
                     jax.ShapeDtypeStruct((bb,), jnp.int32))
             _, kb, B, gg = entry
-            fn = jax.jit(jax.vmap(
-                partial(_items_topk, k=kb, n_items=self.n_items),
-                in_axes=(None, 0, 0)))
+            fn = self._items_program(kb, B, gg)
             return entry, lower_compile(
                 fn, Yn, jax.ShapeDtypeStruct((gg, B), jnp.int32),
                 jax.ShapeDtypeStruct((gg, B), jnp.float32))
@@ -1341,16 +1690,8 @@ class DeviceTopK:
         G, B = idxs.shape
         kb = min(_bucket(k), self.n_items)
         with self._store_lock:
-            prog = self._aot_get_locked(("items", kb, B, G))
-            if prog is None:
-                prog = self._item_programs.get((kb, B, G))
-                if prog is None:
-                    import jax
-
-                    prog = jax.jit(jax.vmap(
-                        partial(_items_topk, k=kb, n_items=self.n_items),
-                        in_axes=(None, 0, 0)))
-                    self._item_programs[(kb, B, G)] = prog
+            prog = self._aot_get_locked(("items", kb, B, G)) \
+                or self._items_program(kb, B, G)
             out = prog(self._normalized_items(), idxs, masks)
         idx, scores = _unpack(np.asarray(out), kb)
         return idx, scores
@@ -1360,8 +1701,22 @@ class DeviceTopK:
     @property
     def item_factors(self):
         """The item-side factor store as served (possibly bf16, possibly
-        sharded) — what the fold-in solve must hold fixed."""
-        return self._Y
+        sharded) — what the fold-in solve must hold fixed. An int8
+        store hands out a DEQUANTIZED fp32 view — the fold-in solve is
+        the training half-step and has no int8 lane, exactly as a bf16
+        store casts to the training lane. The view is built per access,
+        NOT cached: pinning a fp32 copy next to the int8 store would
+        cost more HBM than serving fp32 outright (the catalog-capacity
+        win is the whole point); fold-in reads this once per fold
+        cadence, so the dequant is a transient elementwise program."""
+        from predictionio_tpu.ops.quantize import (
+            dequantize_rows,
+            is_quantized,
+        )
+
+        with self._store_lock:
+            Y = self._Y
+        return dequantize_rows(Y) if is_quantized(Y) else Y
 
     @property
     def user_capacity(self) -> int:
@@ -1389,7 +1744,10 @@ class DeviceTopK:
         patched), so a stream of brand-new users costs O(log growth)
         reallocations, and the compiled top-k programs re-specialize at
         the same cadence. ``factors`` rows are cast to the store dtype
-        (fp32 or the bf16 serving policy). ``seen_items`` replaces the
+        (fp32, the bf16 serving policy, or — for an int8 store —
+        re-quantized with freshly recomputed per-row absmax scales, so
+        a patched row quantizes exactly as it would have at load).
+        ``seen_items`` replaces the
         touched users' on-device seen-masking rows with their full item
         sets (ignored when the server was built without seen masking).
 
@@ -1422,6 +1780,12 @@ class DeviceTopK:
             # paired with its publish in the same statement — an
             # exception can therefore never strand self._X (or the seen
             # tables) pointing at an already-donated, deleted buffer.
+            from predictionio_tpu.ops.quantize import (
+                QuantFactors,
+                is_quantized,
+                quantize_rows_int8_np,
+            )
+
             X = self._X
             needed = int(uids.max()) + 1
             cap = X.shape[0]
@@ -1432,8 +1796,20 @@ class DeviceTopK:
                         "store in place; unknown users on sharded models "
                         "need a retrain")
                 new_cap = _bucket(needed, lo=max(cap, 16))
-                X = jnp.concatenate(
-                    [X, jnp.zeros((new_cap - cap, X.shape[1]), X.dtype)])
+                if is_quantized(X):
+                    # grown rows: zero data with scale 1 (dequant = 0)
+                    X = QuantFactors(
+                        jnp.concatenate(
+                            [X.data, jnp.zeros((new_cap - cap,
+                                                X.data.shape[1]),
+                                               X.data.dtype)]),
+                        jnp.concatenate(
+                            [X.scale, jnp.ones((new_cap - cap,),
+                                               X.scale.dtype)]))
+                else:
+                    X = jnp.concatenate(
+                        [X,
+                         jnp.zeros((new_cap - cap, X.shape[1]), X.dtype)])
             seen_prep = None
             if self._mask_seen and (
                     seen_items or X.shape[0] > self._seen_cols.shape[0]):
@@ -1456,7 +1832,17 @@ class DeviceTopK:
                 cols, mask, sids, row_c, row_m = seen_prep
                 self._seen_cols, self._seen_mask = _scatter_seen(
                     cols, mask, sids, row_c, row_m)
-            self._X = _scatter_rows(X, uids, factors)
+            if is_quantized(X):
+                # fresh rows re-quantize with RECOMPUTED per-row
+                # scales (symmetric absmax, the load-time rule) so a
+                # patched row is bit-identical to quantize-from-scratch
+                # of the updated matrix; data+scale scatter in one
+                # donating dispatch so the pair can never tear
+                q = quantize_rows_int8_np(factors)
+                self._X = QuantFactors(*_scatter_quant_rows(
+                    X.data, X.scale, uids, q.data, q.scale))
+            else:
+                self._X = _scatter_rows(X, uids, factors)
             self.n_users = max(self.n_users, needed)
             if self._store_sig_locked() != sig_before:
                 # grown store: AOT executables are keyed by store

@@ -90,14 +90,24 @@ def _add_tracing_args(p: argparse.ArgumentParser) -> None:
 
 
 def _add_serve_precision_arg(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--serve-precision", choices=("fp32", "bf16"),
+    p.add_argument("--serve-precision", choices=("fp32", "bf16", "int8"),
                    default=None,
                    help="serving factor-store precision (env "
                         "PIO_SERVE_PRECISION; device stores default to "
                         "bf16 on accelerators, fp32 on CPU). bf16 "
                         "halves the model's HBM and scoring traffic; "
-                        "scores still accumulate fp32. fp32 is the "
-                        "opt-out; the host lane is always fp32")
+                        "int8 (per-row fp32 scales, quality-gated like "
+                        "bf16) quarters it. Scores always accumulate "
+                        "fp32. fp32 is the opt-out; the host lane is "
+                        "always fp32")
+    p.add_argument("--serve-kernel", choices=("auto", "fused", "xla"),
+                   default=None,
+                   help="device top-k program family (env "
+                        "PIO_SERVE_KERNEL): 'fused' = the one-program "
+                        "Pallas gather+score+mask+top-k kernel (item "
+                        "tiles stream HBM once per dispatch), 'xla' = "
+                        "the gather/einsum/mask/top_k chain. auto "
+                        "(default) picks fused on TPU, xla elsewhere")
 
 
 def _add_distributed_args(p: argparse.ArgumentParser) -> None:

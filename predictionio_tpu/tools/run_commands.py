@@ -103,6 +103,9 @@ def _apply_precision_flags(args) -> None:
     serve_precision = getattr(args, "serve_precision", None)
     if serve_precision:
         os.environ["PIO_SERVE_PRECISION"] = serve_precision
+    serve_kernel = getattr(args, "serve_kernel", None)
+    if serve_kernel:
+        os.environ["PIO_SERVE_KERNEL"] = serve_kernel
     # --batch-window -> $PIO_BATCH_WINDOW: the micro-batch dispatcher
     # resolves the budget at construction, same env-as-truth discipline
     batch_window = getattr(args, "batch_window", None)

@@ -341,7 +341,15 @@ def warm_up(dep: Deployment,
     by both deploy warm-up and the AOT precompiler, so they can never
     diverge — the old per-bucket warm loop here could (and did) warm
     only the default bucket. Models without a ``warmup_base`` hook but
-    with a ``device_server()`` still get the ladder."""
+    with a ``device_server()`` still get the ladder.
+
+    The ladder is precision- and kernel-agnostic by construction: an
+    int8 store (``pio deploy --serve-precision int8``) and the fused
+    Pallas top-k programs (``--serve-kernel fused``, the TPU default)
+    ride the same ``aot_plan()`` entries — the store signature and the
+    program builders change underneath, the zero-serve-time-compile
+    contract does not (asserted by ``bench.py::serving_load_bench``'s
+    jit monitor for every lane, int8+fused included)."""
     for algo, model in zip(dep.algorithms, dep.models):
         warmup = getattr(algo, "warmup_base", None)
         try:

@@ -8,6 +8,8 @@ import pytest
 from predictionio_tpu.ops.als import ALSParams, _solve_side, pad_ratings
 from predictionio_tpu.ops.als_pallas import solve_side_pallas
 
+pytestmark = pytest.mark.pallas
+
 
 def _problem(n_users=24, n_items=16, rank=8, nnz=200, seed=0):
     rng = np.random.default_rng(seed)

@@ -265,3 +265,13 @@ class TestQualityGate:
         out = bench_quality.run_precision_check()
         assert out["bf16_precision_at_10"] >= \
             out["fp32_precision_at_10"] - 0.02, out
+
+    def test_int8_serving_precision_at_10_within_gate(self):
+        """The same hard gate for the int8 SERVING lane (ISSUE-11):
+        scoring through the symmetric per-row absmax round-trip drops
+        Precision@10 at most 0.02 absolute vs fp32."""
+        import bench_quality
+
+        out = bench_quality.run_precision_check()
+        assert out["int8_serving_precision_at_10"] >= \
+            out["fp32_precision_at_10"] - 0.02, out

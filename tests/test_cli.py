@@ -535,11 +535,17 @@ class TestPrecisionFlags:
     watchdog's configurable-deadline skip artifact."""
 
     def test_unknown_precision_value_rejected(self, capsys):
-        # argparse choices: a typo'd lane must never reach training
+        # argparse choices: a typo'd lane must never reach training.
+        # (int8 is serving-only: valid for --serve-precision since
+        # PR 11, still rejected for the training-side --precision.)
         with pytest.raises(SystemExit):
             main(["train", "--precision", "fp16"])
         with pytest.raises(SystemExit):
-            main(["deploy", "--serve-precision", "int8"])
+            main(["train", "--precision", "int8"])
+        with pytest.raises(SystemExit):
+            main(["deploy", "--serve-precision", "fp16"])
+        with pytest.raises(SystemExit):
+            main(["deploy", "--serve-kernel", "mosaic"])
 
     def test_train_precision_flag_sets_env(self, mem_storage, tmp_path,
                                            capsys, monkeypatch):

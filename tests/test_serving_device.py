@@ -539,6 +539,217 @@ class TestServePrecision:
         assert len(idx) == 5 and np.isfinite(scores).all()
 
 
+class TestInt8Serving:
+    """PIO_SERVE_PRECISION=int8: int8 factor store with per-row fp32
+    absmax scales, fp32 score accumulation — the serving arm one stop
+    further down the Tensor Casting axis than bf16, same gates."""
+
+    @pytest.fixture()
+    def separated(self):
+        """Score gaps (>= ~1.0 between ranks at magnitudes <= ~40)
+        dwarf the int8 step of these rows (scale ~ 40/127 -> error
+        <= ~0.16 per entry): identical top-k ordering required."""
+        rng = np.random.default_rng(11)
+        n_users, n_items, rank = 12, 40, 8
+        X = np.zeros((n_users, rank), dtype=np.float32)
+        X[:, 0] = 1.0
+        X[:, 1] = rng.uniform(-0.01, 0.01, size=n_users)
+        Y = rng.uniform(-0.01, 0.01, size=(n_items, rank)) \
+            .astype(np.float32)
+        Y[:, 0] = np.arange(n_items, dtype=np.float32)
+        return X, Y
+
+    def test_int8_store_and_fp32_scores(self, separated, monkeypatch):
+        from predictionio_tpu.ops.quantize import is_quantized
+
+        X, Y = separated
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "int8")
+        srv = DeviceTopK(X, Y)
+        assert srv._mode == "int8"
+        assert is_quantized(srv._X) and is_quantized(srv._Y)
+        assert str(srv._X.data.dtype) == "int8"
+        assert str(srv._X.scale.dtype) == "float32"
+        idx, scores = srv.user_topk(0, 10)
+        assert scores.dtype == np.float32
+
+    def test_topk_overlap_with_fp32_server(self, separated, monkeypatch):
+        X, Y = separated
+        monkeypatch.delenv("PIO_SERVE_PRECISION", raising=False)
+        ref = DeviceTopK(X, Y)
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "int8")
+        srv = DeviceTopK(X, Y)
+        for uid in range(X.shape[0]):
+            ri, rs = ref.user_topk(uid, 10)
+            qi, qs = srv.user_topk(uid, 10)
+            assert ri.tolist() == qi.tolist()
+            np.testing.assert_allclose(qs, rs, rtol=0.05, atol=0.5)
+        ri, _ = ref.users_topk(np.arange(8), 10)
+        qi, _ = srv.users_topk(np.arange(8), 10)
+        np.testing.assert_array_equal(ri, qi)
+
+    def test_bf16_store_requantizes_to_int8(self, separated,
+                                            monkeypatch):
+        """A bf16-trained store re-quantizes (through fp32) when served
+        int8 — same ordering on separated factors."""
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops.quantize import is_quantized
+
+        X, Y = separated
+        Xb = jnp.asarray(X).astype(jnp.bfloat16)
+        Yb = jnp.asarray(Y).astype(jnp.bfloat16)
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "int8")
+        srv = DeviceTopK(Xb, Yb)
+        assert is_quantized(srv._Y)
+        monkeypatch.delenv("PIO_SERVE_PRECISION", raising=False)
+        ref = DeviceTopK(X, Y)
+        ri, _ = ref.user_topk(2, 8)
+        qi, _ = srv.user_topk(2, 8)
+        assert ri.tolist() == qi.tolist()
+
+    def test_quantized_input_forces_int8_mode(self, separated,
+                                              monkeypatch):
+        """Passing an int8+scales store directly (a quantized artifact)
+        serves int8 regardless of the env."""
+        from predictionio_tpu.ops.quantize import quantize_rows_int8_np
+
+        X, Y = separated
+        monkeypatch.delenv("PIO_SERVE_PRECISION", raising=False)
+        srv = DeviceTopK(quantize_rows_int8_np(X),
+                         quantize_rows_int8_np(Y))
+        assert srv._mode == "int8"
+        idx, scores = srv.user_topk(0, 5)
+        assert np.isfinite(scores).all()
+
+    def test_item_factors_dequantized_for_foldin(self, separated,
+                                                 monkeypatch):
+        """The fold-in solve reads a dense fp32 item view (the training
+        lane has no int8 side), within the quantization error bound of
+        the source factors."""
+        X, Y = separated
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "int8")
+        srv = DeviceTopK(X, Y)
+        Yd = np.asarray(srv.item_factors)
+        assert Yd.dtype == np.float32
+        step = np.abs(Y).max(axis=1, keepdims=True) / 127.0
+        assert (np.abs(Yd[:Y.shape[0]] - Y) <= step / 2 + 1e-7).all()
+
+    def test_choose_server_forces_device_backend(self, monkeypatch):
+        from predictionio_tpu.ops.serving import choose_server
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(10, 4)).astype(np.float32)
+        Y = rng.normal(size=(12, 4)).astype(np.float32)
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "int8")
+        monkeypatch.delenv("PIO_SERVING_BACKEND", raising=False)
+        # auto would pick HostTopK at this size; int8 is an HBM policy
+        assert isinstance(choose_server(X, Y), DeviceTopK)
+        monkeypatch.setenv("PIO_SERVING_BACKEND", "host")
+        with pytest.raises(ValueError, match="PIO_SERVE_PRECISION"):
+            choose_server(X, Y)
+
+    def test_host_server_accepts_int8_store(self, monkeypatch):
+        from predictionio_tpu.ops.quantize import quantize_rows_int8_np
+        from predictionio_tpu.ops.serving import HostTopK
+
+        monkeypatch.delenv("PIO_SERVE_PRECISION", raising=False)
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(10, 4)).astype(np.float32)
+        Y = rng.normal(size=(12, 4)).astype(np.float32)
+        srv = HostTopK(quantize_rows_int8_np(X),
+                       quantize_rows_int8_np(Y))
+        assert srv._X.dtype == np.float32
+        idx, scores = srv.user_topk(0, 5)
+        assert len(idx) == 5 and np.isfinite(scores).all()
+
+    def test_seen_masking_still_applies(self, separated, monkeypatch):
+        X, Y = separated
+        seen = {0: np.asarray([39, 38, 37])}
+        monkeypatch.setenv("PIO_SERVE_PRECISION", "int8")
+        srv = DeviceTopK(X, Y, seen)
+        idx, _ = srv.user_topk(0, 10)
+        assert not (set(idx.tolist()) & {39, 38, 37})
+
+
+class TestScoreEinsumExplicitMode:
+    """_score_einsum takes the store's declared precision explicitly —
+    operand-dtype sniffing is gone, so a mixed-dtype operand pair can
+    no longer silently steer the accumulate path (ISSUE-11 satellite
+    regression)."""
+
+    def _operands(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(2)
+        Y = jnp.asarray(rng.normal(size=(6, 4)).astype(np.float32))
+        u = jnp.asarray(rng.normal(size=(4,)).astype(np.float32))
+        return Y, u
+
+    def test_mode_is_required(self):
+        from predictionio_tpu.ops.serving import _score_einsum
+
+        Y, u = self._operands()
+        with pytest.raises(TypeError):
+            _score_einsum("mr,r->m", Y, u)
+
+    def test_unknown_mode_raises(self):
+        from predictionio_tpu.ops.serving import _score_einsum
+
+        Y, u = self._operands()
+        with pytest.raises(ValueError, match="unknown serving"):
+            _score_einsum("mr,r->m", Y, u, mode="fp16")
+
+    def test_mixed_dtypes_follow_declared_mode(self):
+        """A bf16 operand under mode='fp32' accumulates fp32 on the
+        HIGHEST path (result == fp32 computation of the cast operands)
+        — the old sniffer would have taken the bf16 branch because ONE
+        operand was bf16."""
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops.serving import _score_einsum
+
+        Y, u = self._operands()
+        Yb = Y.astype(jnp.bfloat16)
+        got = _score_einsum("mr,r->m", Yb, u, mode="fp32")
+        assert got.dtype == jnp.float32
+        want = _score_einsum("mr,r->m", Yb.astype(jnp.float32), u,
+                             mode="fp32")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
+    def test_all_modes_return_fp32(self):
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops.quantize import quantize_rows_int8
+        from predictionio_tpu.ops.serving import _score_einsum
+
+        Y, u = self._operands()
+        assert _score_einsum("mr,r->m", Y, u,
+                             mode="fp32").dtype == jnp.float32
+        assert _score_einsum("mr,r->m", Y.astype(jnp.bfloat16),
+                             u.astype(jnp.bfloat16),
+                             mode="bf16").dtype == jnp.float32
+        got = _score_einsum("mr,r->m", quantize_rows_int8(Y), u,
+                            mode="int8")
+        assert got.dtype == jnp.float32
+
+    def test_int8_mode_dequantizes_per_row(self):
+        """int8 scoring == dequantize-then-fp32-einsum, bitwise."""
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops.quantize import (
+            dequantize_rows_np,
+            quantize_rows_int8,
+        )
+        from predictionio_tpu.ops.serving import _score_einsum
+
+        Y, u = self._operands()
+        Yq = quantize_rows_int8(Y)
+        got = np.asarray(_score_einsum("mr,r->m", Yq, u, mode="int8"))
+        want = dequantize_rows_np(Yq) @ np.asarray(u)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
 def _seed(app_name="recapp"):
     aid = storage.get_metadata_apps().insert(App(0, app_name))
     le = storage.get_levents()

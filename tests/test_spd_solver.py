@@ -60,6 +60,7 @@ class TestLanesSolver:
         assert rel.max() < 1e-2
 
 
+@pytest.mark.pallas
 class TestPallasKernelInterpret:
     def test_matches_lapack_tiny(self):
         from predictionio_tpu.ops.als_pallas import spd_solve
