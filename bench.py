@@ -876,6 +876,10 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
         # device compiles but should not pollute the timed levels
         run_level(levels[0], min(1.0, duration_sec))
         compiles0 = metrics.JIT_COMPILES.value()
+        # the flight recorder restarts with the timed levels so the
+        # embedded snapshot describes exactly the measured traffic
+        from predictionio_tpu.utils import device_telemetry
+        device_telemetry.recorder().reset()
 
         sweep = [run_level(q, duration_sec) for q in levels]
         jit_delta = metrics.JIT_COMPILES.value() - compiles0
@@ -899,6 +903,12 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
         slow = tracing.trace_buffer().slow_log(3)
         lanes = [st for st in serving_mod.batcher_stats()
                  if st["dispatches"] > 0]
+        # device-plane snapshot (PR 12): per-lane device-µs percentiles
+        # + AOT hit/miss from the flight recorder, HBM bytes for the
+        # store and the compiled ladder — the artifact alone can verify
+        # whether the fused/int8 lane paid off on this backend
+        flight = device_telemetry.recorder().summary()
+        dev_report = serving_mod.device_report()
 
         return _stamp_device({
             "clients": clients,
@@ -923,6 +933,14 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
             else {"traceId": ex[0], "seconds": round(ex[1], 4)},
             "slow_queries": slow,
             "batchers": lanes,
+            "flight_recorder": flight,
+            "hbm": {
+                "device_store_bytes": dev_report["storeBytes"],
+                "aot_ladder_bytes": dev_report["aotLadderBytes"],
+                "stores": [s["store"] for s in dev_report["stores"]],
+                "ladder_coverage": [s["aotLadder"]["coverage"]
+                                    for s in dev_report["stores"]],
+            },
             "note": ("closed-loop keep-alive HTTP sweep through the "
                      "deadline-aware batching dispatcher; p50/p99 are "
                      "the FIRST level's (lightest load); "
@@ -1209,6 +1227,132 @@ def instrumentation_overhead_bench(n_requests: int = 400,
         "qps_metrics_on": round(qps_on, 1),
         "qps_metrics_off": round(qps_off, 1),
         "overhead_frac": round(max(0.0, 1.0 - qps_on / qps_off), 4),
+    }
+
+
+def device_telemetry_overhead_bench(n_queries: int = 150, rounds: int = 3,
+                                    n_users: int = 64,
+                                    n_items: int = 32) -> dict:
+    """The PR-2 instrumentation-overhead discipline applied to the
+    device-plane flight recorder: drive the SAME deployed query server
+    over HTTP with ``PIO_DEVICE_TELEMETRY`` on and off and report the
+    served-query p50 delta. The recorder-on lane must cost <5% of the
+    served-query p50 (the perf-marked test asserts it), and the
+    zero-steady-state-compile gate stays green in BOTH lanes — the
+    timing wrapper must never introduce a recompile."""
+    import http.client
+
+    import datetime as _dt
+
+    from predictionio_tpu.controller import ComputeContext, EngineParams
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import StorageConfig
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.ops.als import ALSParams
+    from predictionio_tpu.templates.recommendation import (
+        DataSourceParams,
+        engine_factory,
+    )
+    from predictionio_tpu.utils import device_telemetry, metrics
+    from predictionio_tpu.workflow import (
+        QueryServer,
+        ServerConfig,
+        run_train,
+    )
+    from predictionio_tpu.workflow.create_workflow import (
+        WorkflowConfig,
+        new_engine_instance,
+    )
+
+    import os
+
+    factory = "predictionio_tpu.templates.recommendation:engine_factory"
+    storage_mod.reset(StorageConfig(
+        sources={"DTB": {"type": "memory"}},
+        repositories={"METADATA": "DTB", "EVENTDATA": "DTB",
+                      "MODELDATA": "DTB"}))
+    prior_backend = os.environ.get("PIO_SERVING_BACKEND")
+    os.environ["PIO_SERVING_BACKEND"] = "device"  # the instrumented path
+    prior_enabled = device_telemetry.enabled()
+    server = None
+    try:
+        aid = storage_mod.get_metadata_apps().insert(App(0, "dtbench"))
+        le = storage_mod.get_levents()
+        le.init(aid)
+        rng = np.random.default_rng(5)
+        t0 = _dt.datetime(2021, 1, 1, tzinfo=_dt.timezone.utc)
+        le.insert_batch([
+            Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{rng.integers(0, n_items)}",
+                  properties={"rating": float(rng.integers(1, 6))},
+                  event_time=t0)
+            for u in range(n_users) for _ in range(6)], aid)
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(app_name="dtbench")),
+            algorithm_params_list=[
+                ("als", ALSParams(rank=8, num_iterations=2, seed=0))])
+        instance = new_engine_instance(
+            WorkflowConfig(engine_factory=factory), params)
+        iid = run_train(engine_factory(), params, instance,
+                        ctx=ComputeContext())
+        assert iid is not None
+        metrics.install_jit_compile_listener()
+        server = QueryServer(ServerConfig(
+            ip="127.0.0.1", port=0, engine_instance_id=iid)).start(
+            undeploy_stale=False)
+        host, port = server.address
+        body = json.dumps({"user": "u1", "num": 5}).encode("utf-8")
+
+        def one_round() -> list:
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            samples = []
+            for _ in range(n_queries):
+                t0 = time.perf_counter()
+                conn.request(
+                    "POST", "/queries.json", body=body,
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 200, resp.status
+                samples.append(time.perf_counter() - t0)
+            conn.close()
+            return samples
+
+        one_round()  # warm both lanes' code paths
+        compiles0 = metrics.JIT_COMPILES.value()
+        p50 = {}
+        for lane, enabled in (("on", True), ("off", False)):
+            device_telemetry.set_enabled(enabled)
+            best = None
+            for _ in range(rounds):
+                s = np.asarray(one_round())
+                cand = float(np.percentile(s, 50))
+                best = cand if best is None else min(best, cand)
+            p50[lane] = best
+        jit_delta = metrics.JIT_COMPILES.value() - compiles0
+    finally:
+        device_telemetry.set_enabled(prior_enabled)
+        if server is not None:
+            server.stop()
+        if prior_backend is None:
+            os.environ.pop("PIO_SERVING_BACKEND", None)
+        else:
+            os.environ["PIO_SERVING_BACKEND"] = prior_backend
+        storage_mod.reset()
+    return {
+        "queries": n_queries,
+        "p50_ms_telemetry_on": round(p50["on"] * 1e3, 3),
+        "p50_ms_telemetry_off": round(p50["off"] * 1e3, 3),
+        "overhead_frac_p50": round(
+            max(0.0, p50["on"] / p50["off"] - 1.0), 4),
+        "jit_compiles_steady_state": int(jit_delta),
+        "zero_compile_steady_state": jit_delta == 0,
+        "note": ("served-query p50 with the flight recorder on vs the "
+                 "PIO_DEVICE_TELEMETRY=0 killed lane; the <5% gate is "
+                 "asserted by the perf-marked test, the zero-compile "
+                 "gate by the jit monitor across both lanes"),
     }
 
 
@@ -1617,6 +1761,10 @@ def foldin_freshness_bench(n_users: int = 64, n_items: int = 48,
         if interval is not None:
             cfg_kwargs["interval"] = float(interval)
         cfg = FoldInConfig.from_env(**cfg_kwargs)
+        # restart the flight recorder so the embedded snapshot covers
+        # exactly THIS bench's folds and serving dispatches
+        from predictionio_tpu.utils import device_telemetry
+        device_telemetry.recorder().reset()
         consumer = FoldInConsumer(model, cfg, als).start()
 
         # hammer existing users across every patch; count anything
@@ -1669,6 +1817,15 @@ def foldin_freshness_bench(n_users: int = 64, n_items: int = 48,
             t.join(timeout=5)
         stats = consumer.stats()
         consumer.stop()
+        # device-plane snapshot (PR 12): the fold-solve lane's
+        # device-µs percentiles + the live store's HBM report, so the
+        # artifact alone shows what each fold cost on this backend
+        from predictionio_tpu.utils import device_telemetry
+        flight = device_telemetry.recorder().summary()
+        try:
+            hbm = server.memory_report()
+        except Exception:
+            hbm = None
         # None (JSON null), not inf, when every probe timed out:
         # json.dumps renders inf as the non-standard `Infinity`, which
         # would make the artifact unparseable exactly when it matters
@@ -1691,6 +1848,8 @@ def foldin_freshness_bench(n_users: int = 64, n_items: int = 48,
             "new_users": stats["newUsers"],
             "gate_p50_under_5s": bool(
                 lat is not None and float(np.percentile(lat, 50)) < 5.0),
+            "flight_recorder": flight,
+            "hbm": hbm,
             "note": ("event insert -> non-empty top-k for a brand-new "
                      "user through the live patched store; first probe "
                      "includes the fold kernel's one-time jit"),
@@ -1898,6 +2057,11 @@ def main(smoke: bool = False) -> None:
     tracing_overhead = tracing_overhead_bench(
         **({"n_queries": 50, "n_users": 32} if smoke else {}))
 
+    # the device-plane flight recorder's serving tax (PR 12): on vs the
+    # PIO_DEVICE_TELEMETRY=0 killed lane, zero-compile gate both ways
+    telemetry_overhead = device_telemetry_overhead_bench(
+        **({"n_queries": 50, "n_users": 32} if smoke else {}))
+
     batchpredict = batchpredict_bench(
         **({"n_users": 256, "n_items": 128, "chunk": 64,
             "loop_sample": 64} if smoke else {}))
@@ -1949,6 +2113,7 @@ def main(smoke: bool = False) -> None:
         "serving_quantized": serving_quant,
         "instrumentation_overhead": overhead,
         "tracing_overhead": tracing_overhead,
+        "device_telemetry_overhead": telemetry_overhead,
         "batchpredict": batchpredict,
         "chaos_serving": chaos,
         "foldin_freshness": foldin,
@@ -1994,6 +2159,8 @@ def main(smoke: bool = False) -> None:
         "batchpredict_bulk_qps": batchpredict["bulk_queries_per_sec"],
         "batchpredict_speedup_vs_looped":
             batchpredict["speedup_vs_looped"],
+        "device_telemetry_overhead_frac":
+            telemetry_overhead["overhead_frac_p50"],
         "chaos_masked_error_rate":
             chaos["faults_masked"]["error_rate"],
         "chaos_resilience_overhead_frac":

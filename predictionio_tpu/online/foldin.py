@@ -54,7 +54,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from predictionio_tpu.ops.als import ALSParams, fold_in_users
-from predictionio_tpu.utils import metrics
+from predictionio_tpu.utils import device_telemetry, metrics
 from predictionio_tpu.utils.resilience import _env_float
 from predictionio_tpu.utils.tracing import span, trace_scope
 
@@ -146,6 +146,8 @@ class FoldInConsumer:
         self.new_users = 0
         self.events_folded = 0
         self.last_fold_at: Optional[_dt.datetime] = None
+        # device µs of the most recent fold solve (flight recorder)
+        self.last_solve_device_us: Optional[float] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -213,6 +215,7 @@ class FoldInConsumer:
                 "stale": self.stale,
                 "lastFoldAt": None if self.last_fold_at is None
                 else self.last_fold_at.isoformat(),
+                "lastSolveDeviceUs": self.last_solve_device_us,
                 "intervalSec": self._cfg.interval,
                 "countThreshold": self._cfg.count_threshold,
                 "cursor": self._cursor,
@@ -370,10 +373,21 @@ class FoldInConsumer:
                     return
                 server = model.device_server()
                 with span("foldin.solve",
-                          attributes={"users": len(kept_ids)}):
+                          attributes={"users": len(kept_ids)}) as ssp:
                     rows = fold_in_users(server.item_factors, cols_list,
                                          vals_list, self._params,
                                          max_len=self._cfg.max_len)
+                    # the solve's flight record (device-telemetry PR
+                    # 12): pin it to the span so a slow fold's trace
+                    # names its bucket shape + device time, and keep
+                    # the device µs for stats()
+                    rec = device_telemetry.last_record() \
+                        if device_telemetry.enabled() else None
+                    if rec is not None:
+                        if ssp is not None:
+                            ssp.attributes["dispatch"] = rec
+                        with self._stats_lock:
+                            self.last_solve_device_us = rec["deviceUs"]
                 with span("foldin.patch",
                           attributes={"users": len(kept_ids)}):
                     known, new = self._patch(server, kept_ids, cols_list,

@@ -32,6 +32,7 @@ lambda scaling by per-row rating count).
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -984,7 +985,7 @@ _als_iterations_bucketed_jit = None
 from predictionio_tpu.ops.aot import AOTCache as _AOTCache
 
 _AOT_BUCKETED_MAX = 8
-_aot_bucketed = _AOTCache(_AOT_BUCKETED_MAX)
+_aot_bucketed = _AOTCache(_AOT_BUCKETED_MAX, name="train-bucketed")
 
 
 def _bucketed_aot_key(args, kw) -> tuple:
@@ -1318,12 +1319,38 @@ def fold_in_users(item_factors, cols_list: Sequence[np.ndarray],
         return np.zeros((0, Y.shape[1]), dtype=np.float32)
     cols, weights, mask = pad_fold_in_batch(cols_list, vals_list,
                                             max_len=max_len)
-    out = _get_fold_in_jit()(
-        Y, cols, weights, mask,
+    fold_kwargs = dict(
         lam=float(params.lambda_), alpha=float(params.alpha),
         implicit=bool(params.implicit_prefs),
         solver=_spd_solver_mode(), precision=precision,
         refine=bool(params.solve_refine))
+    from predictionio_tpu.utils import device_telemetry as _dtel
+
+    if not _dtel.enabled():
+        # killed-lane fast path (PIO_DEVICE_TELEMETRY=0): no clocks
+        out = _get_fold_in_jit()(Y, cols, weights, mask, **fold_kwargs)
+    else:
+        # the fold-in solve is a device dispatch like any serving
+        # top-k: record its dispatch->block window in the flight ring
+        # (lane "foldin"; kBucket carries the padded history length L,
+        # bucket the padded user batch B) and emit the device.execute
+        # span under the ambient foldin.solve span
+        from predictionio_tpu.utils import tracing as _tracing
+
+        t0m = _time.monotonic()
+        t0e = _tracing.span_now()
+        out = _get_fold_in_jit()(Y, cols, weights, mask, **fold_kwargs)
+        t1m = _time.monotonic()
+        out.block_until_ready()
+        t2m = _time.monotonic()
+        rec = _dtel.record_dispatch(
+            lane="foldin", kernel="xla", precision=precision,
+            aot="jit", k_bucket=int(cols.shape[1]), batch=k,
+            bucket=int(cols.shape[0]),
+            host_us=(t2m - t0m) * 1e6, device_us=(t2m - t1m) * 1e6)
+        _tracing.record_completed_span(
+            "device.execute", start=t0e, end=t0e + (t2m - t0m),
+            attributes=None if rec is None else dict(rec))
     return np.asarray(out[:k], dtype=np.float32)
 
 

@@ -17,12 +17,22 @@ to call directly. Two consumers share this module:
 Both are best-effort: a cache miss (or a jax version whose AOT path
 declines) falls back to the plain jit wrapper, which compiles as
 before — correctness never depends on the cache, only latency does.
+
+Observability (PR 12): evictions are counted and logged WITH the
+dropped key — a fold-in-growth recompile storm shows up as a rising
+``pio_aot_cache_evictions_total`` instead of a mystery — and
+:meth:`AOTCache.memory_report` aggregates ``memory_analysis()`` over
+every compiled entry so the query server's ``/stats.json`` can say how
+much the ladder itself occupies.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any, Dict, Hashable, Iterator, Optional
+
+logger = logging.getLogger("pio.aot")
 
 
 class AOTCache:
@@ -34,26 +44,49 @@ class AOTCache:
     redundant compile wins the slot.
     """
 
-    def __init__(self, max_entries: int = 8):
+    def __init__(self, max_entries: int = 8, name: str = "aot"):
         self._max = int(max_entries)
+        self.name = str(name)
         self._lock = threading.Lock()
         self._entries: Dict[Hashable, Any] = {}
+        self._evictions = 0
+        # memory_analysis is not free and the answer is immutable per
+        # executable — cache the per-entry byte estimate by object id
+        self._mem_cache: Dict[int, Optional[int]] = {}
 
     def get(self, key: Hashable) -> Optional[Any]:
         with self._lock:
             return self._entries.get(key)
 
     def put(self, key: Hashable, compiled: Any) -> None:
+        dropped = []
         with self._lock:
             if key in self._entries:
                 return
             while len(self._entries) >= self._max:
-                self._entries.pop(next(iter(self._entries)))
+                old_key = next(iter(self._entries))
+                old = self._entries.pop(old_key)
+                self._mem_cache.pop(id(old), None)
+                self._evictions += 1
+                dropped.append(old_key)
             self._entries[key] = compiled
+        if dropped:
+            from predictionio_tpu.utils import metrics
+
+            metrics.AOT_CACHE_EVICTIONS.inc(amount=len(dropped))
+            for old_key in dropped:
+                # name WHICH signature fell out: under fold-in growth a
+                # store reshape can thrash the ladder, and a silent FIFO
+                # makes the resulting recompiles look like random
+                # latency instead of a cache too small for its shapes
+                logger.warning(
+                    "%s cache full (%d entries): evicted executable for "
+                    "%r to admit %r", self.name, self._max, old_key, key)
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._mem_cache.clear()
 
     def __len__(self) -> int:
         with self._lock:
@@ -66,6 +99,70 @@ class AOTCache:
     def keys(self) -> Iterator[Hashable]:
         with self._lock:
             return iter(tuple(self._entries))
+
+    @property
+    def evictions(self) -> int:
+        with self._lock:
+            return self._evictions
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "maxEntries": self._max,
+                    "evictions": self._evictions}
+
+    @staticmethod
+    def _entry_bytes(compiled: Any) -> Optional[int]:
+        """One executable's footprint estimate from XLA's own
+        ``memory_analysis()`` (argument + output + temp + generated
+        code, the ``als_precision_bench`` recipe); None where this
+        backend/jax version has no stats."""
+        try:
+            ma = compiled.memory_analysis()
+        except Exception:
+            return None
+        if ma is None:
+            return None
+        total = 0
+        found = False
+        for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                     "temp_size_in_bytes",
+                     "generated_code_size_in_bytes"):
+            try:
+                v = getattr(ma, attr)
+            except AttributeError:
+                continue
+            if v is not None:
+                total += int(v)
+                found = True
+        return total if found else None
+
+    def memory_report(self) -> Dict[str, Any]:
+        """Aggregate ``memory_analysis()`` over every compiled entry:
+        total byte estimate + per-entry breakdown availability. The
+        per-entry answer is cached (executables are immutable), so a
+        scrape pays the XLA query once per compile, not once per poll."""
+        with self._lock:
+            entries = list(self._entries.values())
+        total = 0
+        analyzed = 0
+        for compiled in entries:
+            cached = self._mem_cache.get(id(compiled), "?")
+            if cached == "?":
+                cached = self._entry_bytes(compiled)
+                with self._lock:
+                    # only cache while the executable is still resident:
+                    # caching an id() of a concurrently-evicted (and
+                    # later garbage-collected) executable could hand a
+                    # future executable reusing that id a stale size —
+                    # and the orphan slot would never be reclaimed
+                    if any(v is compiled for v in self._entries.values()):
+                        self._mem_cache[id(compiled)] = cached
+            if cached is not None:
+                total += cached
+                analyzed += 1
+        return {"entries": len(entries), "entriesAnalyzed": analyzed,
+                "totalBytes": total}
 
 
 def lower_compile(jitted, *args, **kwargs) -> Optional[Any]:

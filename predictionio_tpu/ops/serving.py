@@ -49,6 +49,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from predictionio_tpu.ops.aot import AOTCache, lower_compile
+from predictionio_tpu.utils import device_telemetry as _dtel
+from predictionio_tpu.utils import metrics as _metrics
+from predictionio_tpu.utils import tracing as _tracing
 from predictionio_tpu.utils.tracing import span as _trace_span
 
 
@@ -617,11 +620,17 @@ class _BatchResult:
     hundred-query batch does not serialize a hundred numpy filters
     behind one thread."""
 
-    __slots__ = ("idx", "scores")
+    __slots__ = ("idx", "scores", "telemetry")
 
-    def __init__(self, idx: np.ndarray, scores: np.ndarray):
+    def __init__(self, idx: np.ndarray, scores: np.ndarray,
+                 telemetry: Optional[Dict[str, Any]] = None):
         self.idx = idx
         self.scores = scores
+        # the flight-recorder record of the device dispatch that
+        # produced this result (None with telemetry off): waiting
+        # handler threads attach it to their device.* trace span, so a
+        # slow query's exemplar names its bucket/fill/kernel/AOT fate
+        self.telemetry = telemetry
 
     def render(self, row: int, k: int) -> Tuple[np.ndarray, np.ndarray]:
         ri = self.idx[row, :k]
@@ -633,15 +642,22 @@ class _BatchResult:
 class _Pending:
     """One queued query: payload (uid, or item-index tuple), its k, its
     batching deadline (arrival + window; the EDF sort key) and the
-    future the waiting thread blocks on."""
+    future the waiting thread blocks on. ``arrival`` (monotonic) feeds
+    the flight recorder's queue-wait figure; ``ctx`` carries the
+    submitting thread's trace context so the dispatcher thread can
+    parent the ``device.execute`` span under a real query trace."""
 
-    __slots__ = ("payload", "k", "deadline", "seq", "future")
+    __slots__ = ("payload", "k", "deadline", "seq", "future", "arrival",
+                 "ctx")
 
-    def __init__(self, payload, k: int, deadline: float, seq: int):
+    def __init__(self, payload, k: int, deadline: float, seq: int,
+                 arrival: float, ctx=None):
         self.payload = payload
         self.k = k
         self.deadline = deadline
         self.seq = seq
+        self.arrival = arrival
+        self.ctx = ctx
         self.future: Future = Future()
 
     def __lt__(self, other: "_Pending") -> bool:
@@ -677,12 +693,18 @@ class BatchLane:
         self.depth_samples: collections.deque = collections.deque(
             maxlen=512)
 
-    def submit(self, payload, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    def submit(self, payload, k: int,
+               span=None) -> Tuple[np.ndarray, np.ndarray]:
         """Enqueue, block for the shared dispatch, render THIS request's
         rows on the calling thread. Raises :class:`QueryRejectedError`
-        after the PR-7 queue deadline."""
+        after the PR-7 queue deadline. ``span`` (a live trace
+        :class:`~predictionio_tpu.utils.tracing.Span`) receives the
+        dispatch's flight record as a ``dispatch`` attribute — how slow
+        query exemplars get their bucket/fill/kernel/AOT context."""
         k = int(k)
         res, row = self._d.submit_wait(self, payload, k)
+        if span is not None and res.telemetry is not None:
+            span.attributes["dispatch"] = res.telemetry
         return res.render(row, k)
 
     def submit_async(self, payload, k: int,
@@ -785,8 +807,10 @@ class BatchDispatcher:
         if self._closed:
             raise RuntimeError("serving backend is closed")
         w = self.window if window is None else float(window)
-        item = _Pending(payload, k, time.monotonic() + w,
-                        next(self._seq))
+        now = time.monotonic()
+        item = _Pending(payload, k, now + w, next(self._seq),
+                        arrival=now,
+                        ctx=_tracing.current_trace_context())
         # pending is incremented BEFORE the item becomes visible in the
         # handoff: the dispatcher's decrement (at pop, under the stats
         # lock) can then never run before this increment, so the depth
@@ -986,7 +1010,22 @@ class BatchDispatcher:
         try:
             if srv is None:
                 raise RuntimeError("serving backend was released")
-            lane.dispatch_fn(srv, group)
+            if _dtel.enabled():
+                # batching context the device dispatch site cannot see:
+                # the oldest grouped query's queue wait, the group
+                # size, and a trace parent (the dispatcher thread has
+                # no ambient trace of its own — borrow the first traced
+                # query's so the device.execute span lands in a tree)
+                wait = max(0.0, time.monotonic()
+                           - min(it.arrival for it in group))
+                parent = next((it.ctx for it in group
+                               if it.ctx is not None), None)
+                with _dtel.dispatch_scope(queue_wait_us=wait * 1e6,
+                                          group=len(group),
+                                          trace_parent=parent):
+                    lane.dispatch_fn(srv, group)
+            else:
+                lane.dispatch_fn(srv, group)
         except BaseException as e:  # propagate to every waiter
             for it in group:
                 if not it.future.done():
@@ -1026,7 +1065,11 @@ def _dispatch_user_group(srv: "DeviceTopK",
     kmax = max(it.k for it in group)
     uids = np.asarray([it.payload for it in group], dtype=np.int64)
     idx, scores = srv.users_topk(uids, kmax)
-    res = _BatchResult(idx, scores)
+    # the dispatch just recorded on THIS thread (telemetry on): hand
+    # its record to every waiter through the shared result
+    res = _BatchResult(idx, scores,
+                       telemetry=_dtel.last_record()
+                       if _dtel.enabled() else None)
     for row, it in enumerate(group):
         if not it.future.done():
             it.future.set_result((res, row))
@@ -1051,7 +1094,9 @@ def _dispatch_item_group(srv: "DeviceTopK",
         idxs[row, :m] = np.asarray(it.payload, dtype=np.int32)
         masks[row, :m] = 1.0
     idx, scores = srv._items_topk_batched(idxs, masks, kmax)
-    res = _BatchResult(idx, scores)
+    res = _BatchResult(idx, scores,
+                       telemetry=_dtel.last_record()
+                       if _dtel.enabled() else None)
     for row, it in enumerate(group):
         if not it.future.done():
             it.future.set_result((res, row))
@@ -1071,6 +1116,63 @@ def batcher_stats() -> List[Dict[str, Any]]:
         except Exception:  # a server mid-teardown must not 500 /stats
             continue
     return out
+
+
+def _live_store_bytes() -> float:
+    """Total HBM bytes pinned by live device stores (pull-gauge
+    source for ``pio_device_store_bytes``)."""
+    total = 0
+    for srv in list(_live_servers):
+        try:
+            total += srv.memory_report()["totalBytes"]
+        except Exception:
+            continue
+    return float(total)
+
+
+def _live_ladder_bytes() -> float:
+    """Estimated bytes held by AOT ladder executables across live
+    stores (pull-gauge source for ``pio_aot_ladder_bytes``)."""
+    total = 0
+    for srv in list(_live_servers):
+        try:
+            total += srv._aot_programs.memory_report()["totalBytes"]
+        except Exception:
+            continue
+    return float(total)
+
+
+# pull gauges: computed at scrape time from whatever servers are live,
+# so there is no per-server registration/teardown bookkeeping to leak
+_metrics.DEVICE_STORE_BYTES.set_function(_live_store_bytes)
+_metrics.AOT_LADDER_BYTES.set_function(_live_ladder_bytes)
+
+
+def device_report() -> Dict[str, Any]:
+    """The query server's ``/stats.json`` ``device`` block: per-store
+    HBM accounting (factor/seen/scale bytes by dtype, live across
+    fold-in growth and int8 requant), AOT ladder coverage
+    (planned/compiled/warmed/hit) + executable-memory estimate, and the
+    flight recorder's per-lane dispatch summary."""
+    stores: List[Dict[str, Any]] = []
+    store_bytes = ladder_bytes = 0
+    for srv in list(_live_servers):
+        try:
+            mem = srv.memory_report()
+            ladder = srv.ladder_report()
+        except Exception:  # a server mid-teardown must not 500 /stats
+            continue
+        store_bytes += mem["totalBytes"]
+        ladder_bytes += ladder["memory"]["totalBytes"]
+        stores.append({"store": mem, "aotLadder": ladder})
+    rec = _dtel.recorder()
+    return {
+        "telemetry": {"enabled": rec.enabled, **rec.counts()},
+        "storeBytes": store_bytes,
+        "aotLadderBytes": ladder_bytes,
+        "stores": stores,
+        "dispatch": rec.summary(),
+    }
 
 
 _scatter_jits: Dict[bool, object] = {}
@@ -1290,9 +1392,23 @@ class DeviceTopK:
         # (store signature, program shape) so a store reshaped by
         # fold-in growth can never hit a stale executable — the jit
         # program caches above stay as the always-correct fallback
-        self._aot_programs = AOTCache(max_entries=512)
+        self._aot_programs = AOTCache(max_entries=512,
+                                      name="serve-ladder")
+        # ladder observability: lookup outcomes per dispatch (ints
+        # bumped under _store_lock — the lookup already holds it) and
+        # the last warmup()'s coverage figures, surfaced by
+        # ladder_report() / the /stats.json device block
+        self._aot_hits = 0
+        self._aot_misses = 0
+        self._ladder: Dict[str, int] = {"planned": 0, "compiled": 0,
+                                        "fallback": 0, "warmed": 0}
         self._Yn = None  # normalized item matrix, built on first item query
         _live_servers.add(self)
+        # (re)register the HBM pull gauges: a registry reset (test
+        # isolation) drops the scrape-time children registered at
+        # module import, so each new store re-pins them — idempotent
+        _metrics.DEVICE_STORE_BYTES.set_function(_live_store_bytes)
+        _metrics.AOT_LADDER_BYTES.set_function(_live_ladder_bytes)
 
     def _replicate_like_factors(self, arr):
         """When the factors are sharded over a mesh, pin auxiliary tables
@@ -1565,6 +1681,13 @@ class DeviceTopK:
         stats = self.precompile(plan)
         with self._store_lock:
             missing = [e for e in plan if self._aot_get_locked(e) is None]
+            # ladder coverage for the /stats.json device block: how
+            # many programs the plan holds, how many AOT-compiled, how
+            # many fell back and were warmed by execution instead
+            self._ladder = {"planned": len(plan),
+                            "compiled": stats["compiled"],
+                            "fallback": stats["fallback"],
+                            "warmed": len(missing)}
         for entry in missing:  # jit-compile the stragglers by running
             if entry[0] == "user":
                 self._user_topk_direct(0, entry[1])
@@ -1601,6 +1724,57 @@ class DeviceTopK:
 
     # -- serving ----------------------------------------------------------
 
+    def _dispatch_entry(self, entry: Tuple, fallback, args_fn, *,
+                        batch: int, bucket: int):
+        """One laddered device dispatch: AOT-executable lookup + the
+        program call under ``_store_lock`` (the historical lock scope —
+        the dispatch enqueues, it does not wait on the device), then,
+        with telemetry on, the dispatch→``block_until_ready`` window
+        timed OUTSIDE the lock on the monotonic clock, recorded into
+        the flight ring and emitted as a ``device.execute`` child span.
+        Telemetry off (``PIO_DEVICE_TELEMETRY=0``) is the killed-lane
+        fast path: exactly the pre-telemetry dispatch, no clock reads.
+        Returns the raw packed device output."""
+        tel = _dtel.enabled()
+        with self._store_lock:
+            aot_prog = self._aot_get_locked(entry)
+            if aot_prog is not None:
+                self._aot_hits += 1
+                prog = aot_prog
+            else:
+                self._aot_misses += 1
+                prog = fallback()
+            args = args_fn()
+            if not tel:
+                _metrics.AOT_CACHE_REQUESTS.inc(
+                    result="hit" if aot_prog is not None else "miss_jit")
+                return prog(*args)
+            t0m = time.monotonic()
+            t0e = _tracing.span_now()
+            out = prog(*args)
+            t1m = time.monotonic()
+        _metrics.AOT_CACHE_REQUESTS.inc(
+            result="hit" if aot_prog is not None else "miss_jit")
+        # block OUTSIDE the lock (a fold-in patch must not wait on a
+        # query's device time); the d2h fetch the caller then pays via
+        # np.asarray finds the result already materialized
+        try:
+            out.block_until_ready()
+        except AttributeError:  # non-jax output (host fallback paths)
+            pass
+        t2m = time.monotonic()
+        rec = _dtel.record_dispatch(
+            lane=entry[0], kernel=self._kernel, precision=self._mode,
+            aot="hit" if aot_prog is not None else "miss_jit",
+            k_bucket=int(entry[1]), batch=batch, bucket=bucket,
+            host_us=(t2m - t0m) * 1e6, device_us=(t2m - t1m) * 1e6)
+        ctx = _dtel.current_dispatch_context() or {}
+        _tracing.record_completed_span(
+            "device.execute", start=t0e, end=t0e + (t2m - t0m),
+            attributes=None if rec is None else dict(rec),
+            parent=ctx.get("traceParent"))
+        return out
+
     def user_topk(self, uid: int, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """(item indices, scores) for one user, descending; seen items
         are masked on device. With micro-batching on (the default),
@@ -1608,9 +1782,10 @@ class DeviceTopK:
         still pays exactly one blocking round trip."""
         # the trace span covers submit→result, i.e. the full device
         # round trip the query waits on (micro-batched or direct)
-        with _trace_span("device.user_topk", attributes={"k": int(k)}):
+        with _trace_span("device.user_topk",
+                         attributes={"k": int(k)}) as sp:
             if self._batcher is not None:
-                return self._batcher.submit(int(uid), int(k))
+                return self._batcher.submit(int(uid), int(k), span=sp)
             return self._user_topk_direct(uid, k)
 
     def _user_topk_direct(self, uid: int,
@@ -1619,11 +1794,11 @@ class DeviceTopK:
         bucket and the result is clipped, so arbitrary nums reuse
         programs; the uid rides inside the async jit dispatch."""
         kb = min(_bucket(k), self.n_items)
-        with self._store_lock:
-            prog = self._aot_get_locked(("user", kb)) \
-                or self._user_program(kb)
-            out = prog(self._X, self._Y, self._seen_cols,
-                       self._seen_mask, np.int32(uid))
+        out = self._dispatch_entry(
+            ("user", kb), lambda: self._user_program(kb),
+            lambda: (self._X, self._Y, self._seen_cols, self._seen_mask,
+                     np.int32(uid)),
+            batch=1, bucket=1)
         idx, scores = _unpack(np.asarray(out), kb)
         idx, scores = idx[:k], scores[:k]
         valid = np.isfinite(scores)
@@ -1647,11 +1822,11 @@ class DeviceTopK:
             padded = np.zeros(bb, dtype=np.int32)
             padded[:n] = uids
             kb = min(_bucket(k), self.n_items)
-            with self._store_lock:
-                prog = self._aot_get_locked(("users", kb, bb)) \
-                    or self._batch_program(kb, bb)
-                out = prog(self._X, self._Y, self._seen_cols,
-                           self._seen_mask, padded)
+            out = self._dispatch_entry(
+                ("users", kb, bb), lambda: self._batch_program(kb, bb),
+                lambda: (self._X, self._Y, self._seen_cols,
+                         self._seen_mask, padded),
+                batch=n, bucket=bb)
             idx, scores = _unpack(np.asarray(out), kb)
             return idx[:n, :k], scores[:n, :k]
 
@@ -1660,10 +1835,11 @@ class DeviceTopK:
         micro-batching on, concurrent callers share one vmapped
         dispatch (same discipline as ``user_topk``)."""
         with _trace_span("device.items_topk",
-                         attributes={"items": len(idxs), "k": int(k)}):
+                         attributes={"items": len(idxs),
+                                     "k": int(k)}) as sp:
             if self._item_batcher is not None:
                 return self._item_batcher.submit(
-                    tuple(int(i) for i in idxs), int(k))
+                    tuple(int(i) for i in idxs), int(k), span=sp)
             return self._items_topk_direct(idxs, k)
 
     def _items_topk_direct(self, idxs,
@@ -1689,12 +1865,81 @@ class DeviceTopK:
         bucket: G concurrent item queries, one dispatch, one fetch."""
         G, B = idxs.shape
         kb = min(_bucket(k), self.n_items)
-        with self._store_lock:
-            prog = self._aot_get_locked(("items", kb, B, G)) \
-                or self._items_program(kb, B, G)
-            out = prog(self._normalized_items(), idxs, masks)
+        # the [G, B] bucket is already padded — the REAL group size is
+        # the dispatcher's, carried in the dispatch context (G itself
+        # for direct single-row calls)
+        ctx = _dtel.current_dispatch_context() or {}
+        out = self._dispatch_entry(
+            ("items", kb, B, G), lambda: self._items_program(kb, B, G),
+            lambda: (self._normalized_items(), idxs, masks),
+            batch=int(ctx.get("group") or G), bucket=G)
         idx, scores = _unpack(np.asarray(out), kb)
         return idx, scores
+
+    # -- device-plane accounting (HBM + AOT ladder) ------------------------
+
+    def memory_report(self) -> Dict[str, Any]:
+        """HBM bytes this store pins, by component and dtype — factor
+        tables (int8 stores split data vs per-row scales), seen tables,
+        and the lazily built normalized item matrix. Reads the LIVE
+        references under ``_store_lock``, so the answer tracks fold-in
+        growth and int8 requant as they happen."""
+        from predictionio_tpu.ops.quantize import is_quantized
+
+        with self._store_lock:
+            X, Y, Yn = self._X, self._Y, self._Yn
+            sc, sm = self._seen_cols, self._seen_mask
+            mode, kernel = self._mode, self._kernel
+
+        def comp(f) -> Optional[Dict[str, Any]]:
+            if f is None:
+                return None
+            if is_quantized(f):
+                return {"bytes": int(f.data.nbytes),
+                        "scaleBytes": int(f.scale.nbytes),
+                        "dtype": str(f.data.dtype),
+                        "scaleDtype": str(f.scale.dtype),
+                        "shape": [int(d) for d in f.data.shape]}
+            return {"bytes": int(f.nbytes), "scaleBytes": 0,
+                    "dtype": str(f.dtype),
+                    "shape": [int(d) for d in f.shape]}
+
+        components: Dict[str, Any] = {
+            "userFactors": comp(X),
+            "itemFactors": comp(Y),
+            "normalizedItems": comp(Yn),
+            "seen": {"bytes": int(sc.nbytes + sm.nbytes),
+                     "dtype": f"{sc.dtype}+{sm.dtype}",
+                     "shape": [int(d) for d in sc.shape]}
+            if self._mask_seen else None,
+        }
+        total = sum(c["bytes"] + c.get("scaleBytes", 0)
+                    for c in components.values() if c is not None)
+        return {
+            "precision": mode,
+            "kernel": kernel,
+            "nUsers": self.n_users,
+            "nItems": self.n_items,
+            "userCapacity": int(X.shape[0]),
+            "components": components,
+            "totalBytes": int(total),
+        }
+
+    def ladder_report(self) -> Dict[str, Any]:
+        """AOT bucket-ladder coverage and footprint: the last warmup's
+        planned/compiled/fallback/warmed counts, live hit/miss-to-jit
+        lookup totals, cache entry/eviction counts, and the aggregated
+        ``memory_analysis()`` byte estimate over every compiled
+        executable."""
+        with self._store_lock:
+            hits, misses = self._aot_hits, self._aot_misses
+            coverage = dict(self._ladder)
+        return {
+            "coverage": coverage,
+            "requests": {"hit": hits, "missJit": misses},
+            "cache": self._aot_programs.stats(),
+            "memory": self._aot_programs.memory_report(),
+        }
 
     # -- live store patching (online fold-in) ------------------------------
 

@@ -369,6 +369,24 @@ def build_parser() -> argparse.ArgumentParser:
     _add_trace_source(trt)
     tr.set_defaults(func=trace_commands.dispatch)
 
+    from predictionio_tpu.tools import top_command
+
+    top = sub.add_parser(
+        "top",
+        help="live terminal view of a deployed query server: QPS, "
+             "p50/p99, batch fill, device-vs-host time split, HBM, "
+             "breaker/degraded/fold-in state (polls /stats.json + "
+             "/dispatches.json)")
+    top.add_argument("--url", default=None, metavar="URL",
+                     help="the query server's base URL (default "
+                          f"{top_command.DEFAULT_URL})")
+    top.add_argument("--interval", type=float, default=2.0, metavar="SEC",
+                     help="refresh cadence in seconds (default 2)")
+    top.add_argument("--once", action="store_true",
+                     help="print one plain snapshot and exit "
+                          "(scripts/CI; no ANSI)")
+    top.set_defaults(func=top_command.cmd_top)
+
     tpl = sub.add_parser("template", help="engine template scaffolds")
     tpl_sub = tpl.add_subparsers(dest="template_command")
     tpl_sub.add_parser("list", help="list built-in templates")

@@ -145,7 +145,8 @@ class InstrumentedHandlerMixin:
     # tracing, so these routes still join an existing trace (retention
     # then rides the caller's sampling decision).
     _UNTRACED_ROUTES = ("/", "/healthz", "/metrics", "/stats.json",
-                        "/traces.json", "/traces/<id>")
+                        "/dispatches.json", "/traces.json",
+                        "/traces/<id>")
 
     # -- dispatch shell ----------------------------------------------------
     def _dispatch_instrumented(self, method: str, path: str,

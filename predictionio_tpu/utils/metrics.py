@@ -622,6 +622,42 @@ FOLDIN_STALE = REGISTRY.gauge(
     "the last-good factors, responses carry degradedReasons "
     "foldin_stale)", ())
 
+# -- device-plane telemetry (PR 12) ----------------------------------------
+# device dispatches are sub-millisecond on a healthy accelerator; the
+# default latency bounds' 0.5ms floor would collapse every fused-lane
+# dispatch into one bucket
+DEVICE_DISPATCH_BUCKETS = (0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
+                           0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                           0.5, 2.0)
+DISPATCH_DEVICE_SECONDS = REGISTRY.histogram(
+    "pio_dispatch_device_seconds",
+    "Device time per serving dispatch (dispatch -> block_until_ready on "
+    "the monotonic clock) by lane, kernel family and store precision",
+    ("lane", "kernel", "precision"), buckets=DEVICE_DISPATCH_BUCKETS)
+AOT_CACHE_REQUESTS = REGISTRY.counter(
+    "pio_aot_cache_requests_total",
+    "Serving-program lookups against the AOT bucket ladder (hit = "
+    "precompiled executable; miss_jit = jit fallback, e.g. a store "
+    "reshaped by fold-in growth before the next warmup)",
+    ("result",))
+AOT_CACHE_EVICTIONS = REGISTRY.counter(
+    "pio_aot_cache_evictions_total",
+    "AOT executables evicted from a bounded cache (a rising rate under "
+    "fold-in growth is a recompile storm, not a mystery)", ())
+DEVICE_STORE_BYTES = REGISTRY.gauge(
+    "pio_device_store_bytes",
+    "HBM bytes pinned by live device factor stores (factors + scales + "
+    "seen tables + normalized item matrix, across all live servers)", ())
+AOT_LADDER_BYTES = REGISTRY.gauge(
+    "pio_aot_ladder_bytes",
+    "Estimated bytes held by AOT-compiled serving ladder executables "
+    "(memory_analysis over every compiled entry; 0 where the backend "
+    "has no stats)", ())
+PROFILE_CAPTURES_ACTIVE = REGISTRY.gauge(
+    "pio_profile_capture_active",
+    "1 while an on-demand jax.profiler capture (POST /profile/start) "
+    "is running", ())
+
 # -- training workflow -----------------------------------------------------
 TRAIN_STAGE_LATENCY = REGISTRY.histogram(
     "pio_train_stage_seconds",

@@ -615,6 +615,22 @@ class TraceBuffer:
                     "error": err,
                     "spans": len(new_spans),
                 }
+                # dispatch context (PR 12): the device-telemetry layer
+                # attaches its flight record to the device.* span, so a
+                # slow exemplar names its bucket/batch/fill/kernel/AOT
+                # outcome — diagnosable without reproducing it
+                disp = None
+                for s in new_spans:
+                    d = getattr(s, "attributes", {}).get("dispatch")
+                    if d is not None:
+                        disp = d
+                if disp is not None:
+                    slow_entry["dispatch"] = disp
+                capture = PROFILER.active_dir
+                if capture:
+                    # a profiler capture was running while this query
+                    # was slow: the slow log links straight to it
+                    slow_entry["profileCapture"] = capture
                 self._slow.append(slow_entry)
         if slow_entry is not None:
             slow_logger.warning(
@@ -932,6 +948,37 @@ def span(name: str, level: int = logging.DEBUG,
             logger.log(level, "%s took %.3fs", name, took)
 
 
+def span_now() -> float:
+    """The span clock (monotonic-anchored epoch seconds) — public so
+    instrumentation that times work OUTSIDE the span machinery (the
+    device-dispatch telemetry window) can stamp spans on the same clock
+    every other span uses."""
+    return _now()
+
+
+def record_completed_span(name: str, start: float, end: float,
+                          attributes: Optional[Dict[str, Any]] = None,
+                          parent: Optional[SpanContext] = None
+                          ) -> Optional[Span]:
+    """Record an ALREADY-FINISHED span — for work whose window was
+    timed with raw clock reads rather than a context manager (e.g. the
+    dispatch→``block_until_ready`` device window, which must cost two
+    monotonic reads, not a contextvar rebind). Parents under ``parent``
+    when given, else the ambient context; no-ops (returns None) when
+    tracing is off or no trace is active. ``start``/``end`` must come
+    from :func:`span_now`."""
+    if not TRACES.enabled:
+        return None
+    ctx = parent if parent is not None else _trace_ctx.get()
+    if ctx is None:
+        return None
+    sp = Span(ctx.trace_id, new_span_id(), ctx.span_id, name, attributes)
+    sp.start = float(start)
+    sp.end = float(end)
+    TRACES.add_span(sp)
+    return sp
+
+
 @contextlib.contextmanager
 def detached_span(name: str, parent: Optional[SpanContext] = None,
                   attributes: Optional[Dict[str, Any]] = None):
@@ -1178,6 +1225,97 @@ started {_html.escape(str(record.get('startTime', '')))}</p>
 # ---------------------------------------------------------------------------
 # jax.profiler wrapper
 # ---------------------------------------------------------------------------
+
+class ProfilerBusyError(RuntimeError):
+    """``POST /profile/start`` while a capture is already running (the
+    server renders this 409): ``jax.profiler`` is process-global, so
+    captures are strictly single-flight."""
+
+
+class ProfilerNotRunningError(RuntimeError):
+    """``POST /profile/stop`` with no active capture (409)."""
+
+
+class ProfilerCapture:
+    """Single-flight on-demand ``jax.profiler`` capture for a LIVE
+    process — the start/stop twin of :func:`profile_trace` (same
+    counter, same jit-compile listener side effect), driven by the
+    query server's ``POST /profile/start`` / ``/profile/stop``.
+
+    Captures land under a ``profiles/`` subdirectory next to the
+    ``--trace-dir`` JSONL exports (or ``$PIO_PROFILE_DIR``, or a
+    temp directory as the last resort), and the slow-query log
+    cross-links entries recorded while a capture was running."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dir: Optional[str] = None
+        self._t0: float = 0.0
+
+    @property
+    def active_dir(self) -> Optional[str]:
+        return self._dir
+
+    def resolve_base_dir(self) -> str:
+        """Where captures go: next to the trace export, else
+        $PIO_PROFILE_DIR, else a fresh temp dir."""
+        export = TRACES._export_dir
+        if export:
+            return os.path.join(export, "profiles")
+        env = os.environ.get("PIO_PROFILE_DIR")
+        if env:
+            return env
+        import tempfile
+
+        return tempfile.mkdtemp(prefix="pio-profile-")
+
+    def start(self, base_dir: Optional[str] = None) -> str:
+        from predictionio_tpu.utils import metrics
+
+        with self._lock:
+            if self._dir is not None:
+                raise ProfilerBusyError(
+                    f"a profiler capture is already running "
+                    f"({self._dir}); stop it first")
+            base = base_dir or self.resolve_base_dir()
+            path = os.path.join(
+                base, time.strftime("profile-%Y%m%dT%H%M%SZ", time.gmtime()))
+            os.makedirs(path, exist_ok=True)
+            metrics.install_jit_compile_listener()
+            import jax
+
+            jax.profiler.start_trace(path)
+            self._dir = path
+            self._t0 = time.perf_counter()
+        metrics.PROFILE_CAPTURES_ACTIVE.set(1)
+        logger.info("profiler capture started -> %s", path)
+        return path
+
+    def stop(self) -> Dict[str, Any]:
+        from predictionio_tpu.utils import metrics
+
+        with self._lock:
+            if self._dir is None:
+                raise ProfilerNotRunningError(
+                    "no profiler capture is running")
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                # whatever stop_trace did, the capture is OVER: clear
+                # the slot AND the gauge, or a failed stop would pin
+                # pio_profile_capture_active at 1 with nothing running
+                path, self._dir = self._dir, None
+                metrics.PROFILE_CAPTURES_ACTIVE.set(0)
+            took = time.perf_counter() - self._t0
+        metrics.PROFILE_TRACES.inc()
+        logger.info("profiler capture written to %s (%.3fs)", path, took)
+        return {"profileDir": path, "durationSec": round(took, 3)}
+
+
+PROFILER = ProfilerCapture()
+
 
 @contextlib.contextmanager
 def profile_trace(trace_dir: Optional[str] = None):

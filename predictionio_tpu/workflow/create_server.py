@@ -471,6 +471,7 @@ class QueryServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.scheme = "http"  # resolved from server.json at start()
+        self._profile_auth = None  # KeyAuthentication, set at start()
 
     # -- deploy ------------------------------------------------------------
     def _resolve_instance(self) -> EngineInstance:
@@ -792,14 +793,43 @@ class QueryServer:
         """GET /stats.json: the status page, the live micro-batch
         lanes' unified ``batcher_stats`` (dispatch triggers, batch-fill
         ratio, queue-depth percentiles — one shape for user and item
-        lanes), plus the process-wide registry snapshot
-        (pio_query_seconds, pio_microbatch_*, pio_storage_op_* ... —
-        the same state GET /metrics renders as Prometheus text)."""
+        lanes), the ``device`` block (store + AOT ladder HBM bytes,
+        ladder coverage, flight-recorder dispatch summary), plus the
+        process-wide registry snapshot (pio_query_seconds,
+        pio_microbatch_*, pio_storage_op_* ... — the same state
+        GET /metrics renders as Prometheus text)."""
         from predictionio_tpu.ops import serving as _serving
 
         return {**self.status(),
                 "batchers": _serving.batcher_stats(),
+                "device": _serving.device_report(),
                 "metrics": metrics.registry().snapshot()}
+
+    def dispatches_json(self, limit: int = 100) -> Dict[str, Any]:
+        """GET /dispatches.json: the device-plane flight recorder —
+        the last N dispatches (lane, bucket shape, batch/fill,
+        precision, kernel, AOT hit/miss, queue wait, host + device µs)
+        plus per-lane percentile summaries."""
+        from predictionio_tpu.utils import device_telemetry
+
+        return device_telemetry.recorder().report(limit=limit)
+
+    def profile_start(self) -> Dict[str, Any]:
+        """POST /profile/start: begin a single-flight jax.profiler
+        capture on the LIVE server (written next to the --trace-dir
+        exports). A second start while one runs raises (HTTP 409)."""
+        from predictionio_tpu.utils.tracing import PROFILER
+
+        return {"message": "profiler capture started",
+                "profileDir": PROFILER.start()}
+
+    def profile_stop(self) -> Dict[str, Any]:
+        """POST /profile/stop: end the active capture; 409 when none
+        is running."""
+        from predictionio_tpu.utils.tracing import PROFILER
+
+        return {"message": "profiler capture written",
+                **PROFILER.stop()}
 
     def health_checks(self) -> Dict[str, bool]:
         """Readiness for ``GET /healthz``: a deployment is loaded, the
@@ -820,11 +850,17 @@ class QueryServer:
         # reference deploys HTTPS via server.conf + SSLConfiguration)
         from predictionio_tpu.common import SSLConfiguration
         from predictionio_tpu.common.auth import (
+            KeyAuthentication,
             ServerConfig as AuthServerConfig,
         )
 
-        sslc = SSLConfiguration(
-            AuthServerConfig.load(self.config.server_config_path))
+        auth_cfg = AuthServerConfig.load(self.config.server_config_path)
+        # the profiler-capture endpoints are operator actions on a live
+        # server: when server.json configures an accessKey they require
+        # it (KeyAuthentication, the dashboard's rule); without one the
+        # server is open, matching every other operator surface here
+        self._profile_auth = KeyAuthentication(auth_cfg)
+        sslc = SSLConfiguration(auth_cfg)
         self.scheme = "https" if sslc.enabled else "http"
         if self._deployment is None:
             self.deploy()
@@ -931,7 +967,8 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         return self.rfile.read(length) if length else b""
 
     _ROUTES = ("/", "/healthz", "/metrics", "/stats.json",
-               "/plugins.json", "/queries.json", "/reload", "/stop",
+               "/dispatches.json", "/plugins.json", "/queries.json",
+               "/profile/start", "/profile/stop", "/reload", "/stop",
                "/traces.json")
 
     def _route_label(self, path: str) -> str:
@@ -944,7 +981,7 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         path = parsed.path.rstrip("/") or "/"
         query = urllib.parse.parse_qs(parsed.query)
         handle = (lambda: self._do_get(path, query)) if method == "GET" \
-            else (lambda: self._do_post(path))
+            else (lambda: self._do_post(path, query))
         self._dispatch_instrumented(method, path, handle)
 
     def _do_get(self, path: str, query) -> None:
@@ -958,6 +995,13 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
             self._respond_prometheus()
         elif path == "/stats.json":
             self._respond(200, srv.stats_json())
+        elif path == "/dispatches.json":
+            try:
+                limit = min(int(self._q_first(query, "limit") or 100),
+                            2048)
+            except ValueError:
+                limit = 100
+            self._respond(200, srv.dispatches_json(limit=limit))
         elif path == "/traces.json":
             self._respond_traces_index(query)
         elif path.startswith("/traces/"):
@@ -967,11 +1011,13 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         else:
             self._respond(404, {"message": "Not Found"})
 
-    def _do_post(self, path: str) -> None:
+    def _do_post(self, path: str, query=None) -> None:
         srv = self.query_server
         body = self._drain()
         try:
-            if path == "/queries.json":
+            if path in ("/profile/start", "/profile/stop"):
+                self._handle_profile(path, query or {})
+            elif path == "/queries.json":
                 status, payload = srv.handle_query(body)
                 if status == 503 and isinstance(payload, dict) \
                         and payload.get("retryAfterSec") is not None:
@@ -1012,6 +1058,28 @@ class _QueryHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
                 self._respond(500, {"message": str(e)})
             except Exception:
                 pass
+
+    def _handle_profile(self, path: str, query) -> None:
+        """On-demand profiler capture: authed (server.json accessKey,
+        when configured), single-flight — a second start, or a stop
+        with nothing running, is 409."""
+        from predictionio_tpu.utils.tracing import (
+            ProfilerBusyError,
+            ProfilerNotRunningError,
+        )
+
+        srv = self.query_server
+        auth = srv._profile_auth
+        if auth is not None and not auth.authenticate(query):
+            self._respond(403, {"message": "invalid accessKey"})
+            return
+        try:
+            if path == "/profile/start":
+                self._respond(200, srv.profile_start())
+            else:
+                self._respond(200, srv.profile_stop())
+        except (ProfilerBusyError, ProfilerNotRunningError) as e:
+            self._respond(409, {"message": str(e)})
 
     def do_GET(self):
         self._dispatch("GET")
