@@ -473,7 +473,7 @@ class Channel:
 class EngineInstance:
     """EngineInstances.scala:43-59 — one train run's full record."""
     id: str
-    status: str  # INIT | TRAINING | COMPLETED | FAILED
+    status: str  # INIT | TRAINING | COMPLETED | FAILED | INTERRUPTED
     start_time: _dt.datetime
     end_time: _dt.datetime
     engine_id: str

@@ -24,17 +24,32 @@ _SAFE = re.compile(r"[^A-Za-z0-9._-]")
 
 
 def atomic_write_bytes(path: str, data: bytes) -> None:
-    """Crash-safe file write: temp file in the target directory + atomic
-    rename, so readers only ever see complete content. Shared by the
-    model blob store below and the jsonlfs entity-props snapshot (the
-    two filesystem stores that persist derived state a crashed writer
-    must never leave torn)."""
+    """Crash-safe file write: temp file in the target directory,
+    fsync, then atomic rename — readers only ever see complete
+    content, and the content survives a crash that outlives the page
+    cache (a kill-9 never loses a rename; power loss needs the fsync).
+    Shared by the model blob store below, the jsonlfs entity-props
+    snapshot, the batchpredict manifest and the training checkpoints —
+    every filesystem store that persists derived state a crashed
+    writer must never leave torn."""
     d = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_" + os.path.basename(path))
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)  # atomic on POSIX
+        try:
+            # directory-entry durability (the rename itself), best
+            # effort — not every fs/platform lets you fsync a dir fd
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)

@@ -77,6 +77,17 @@ class ALSParams(Params):
     # assembled A/b carry bf16 rounding, at ~2x solve cost. Off by
     # default; meaningful mainly under precision="bf16".
     solve_refine: bool = False
+    # crash-safe training (workflow/checkpoint.py): run the iteration
+    # scan in chunks of this many iterations per device program so the
+    # host can snapshot an atomic checkpoint, honor SIGTERM/SIGINT and
+    # guard divergence between chunks. None/0 = off (today's
+    # single-scan path, untouched). Chunked training is byte-identical
+    # to unchunked — the per-iteration program and every reduction
+    # order are unchanged (differential-gated) — so this is an
+    # execution knob, excluded from the checkpoint fingerprint.
+    # PIO_CHECKPOINT_EVERY overrides; checkpoints only land when
+    # PIO_CHECKPOINT_DIR is also set (pio train --checkpoint-dir).
+    checkpoint_every: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -1039,13 +1050,79 @@ def _als_iterations_bucketed(*args, **kw):
     return jitted(*args, **kw)
 
 
+def checkpoint_layout_uniform(user_side: PaddedRatings,
+                              item_side: PaddedRatings):
+    """Layout half of the checkpoint fingerprint for uniform tables:
+    row/col spaces + padded shapes + valid-row counts. Shared by the
+    single-device and sharded trainers — the numerics are identical
+    across topologies (differential-tested), so a checkpoint is
+    resumable on either."""
+    def side(s):
+        return (int(s.n_rows), int(s.n_cols), int(s.max_len),
+                int(s.valid_rows))
+
+    return ("uniform", side(user_side), side(item_side))
+
+
+def checkpoint_layout_bucketed(user_side: BucketedRatings,
+                               item_side: BucketedRatings):
+    """Layout half of the checkpoint fingerprint for bucketed sides:
+    row/col spaces + every bucket's padded table shape."""
+    def side(s):
+        return (int(s.n_rows), int(s.n_cols),
+                tuple(tuple(int(d) for d in b.cols.shape)
+                      for b in s.buckets))
+
+    return ("bucketed", side(user_side), side(item_side))
+
+
+def _maybe_checkpointer(layout, params: ALSParams, solver: str,
+                        precision: str, dtype=None):
+    """The active TrainCheckpointer for this call, or None. Gated on
+    the env var BEFORE importing the checkpoint module so the
+    (production-default) inactive path costs one dict lookup and never
+    pulls the workflow package into a pure ops call."""
+    import os
+
+    if not os.environ.get("PIO_CHECKPOINT_DIR", "").strip():
+        return None
+    from predictionio_tpu.workflow import checkpoint as _checkpoint
+
+    return _checkpoint.checkpointer_for(layout, params, solver,
+                                        precision, dtype)
+
+
+def _checkpoint_chunk_lengths(params: ALSParams) -> tuple:
+    """The distinct static trip counts the chunked loop will dispatch
+    (at most two: the chunk length and a remainder) — what the AOT
+    warm-up must cover so chunked training keeps the zero-recompile
+    contract. Falls back to the single scan when checkpointing is off
+    or misconfigured (warm-up is best-effort by contract)."""
+    import os
+
+    total = int(params.num_iterations)
+    if not os.environ.get("PIO_CHECKPOINT_DIR", "").strip():
+        return (total,)
+    try:
+        from predictionio_tpu.workflow import checkpoint as _checkpoint
+
+        return tuple(sorted(set(
+            _checkpoint.chunk_schedule(
+                total, _checkpoint.resolve_every(params)))))
+    except Exception:
+        return (total,)
+
+
 def _bucketed_call_args(user_side: BucketedRatings,
                         item_side: BucketedRatings, params: ALSParams,
-                        precision: str, abstract: bool = False):
+                        precision: str, abstract: bool = False,
+                        num_iterations: Optional[int] = None):
     """The exact (args, static kwargs) train_als_bucketed passes to the
     jitted loop — shared with the AOT warm-up so a warmed signature is
     guaranteed to match the real call. ``abstract=True`` replaces every
-    array with its ShapeDtypeStruct."""
+    array with its ShapeDtypeStruct. ``num_iterations`` overrides the
+    params value — the chunked checkpoint loop dispatches
+    chunk-length scans, and the warm-up lowers the same lengths."""
     import jax
 
     def leaf(a):
@@ -1065,7 +1142,9 @@ def _bucketed_call_args(user_side: BucketedRatings,
     kw = dict(
         lam=float(params.lambda_), alpha=float(params.alpha),
         implicit=bool(params.implicit_prefs),
-        num_iterations=int(params.num_iterations),
+        num_iterations=int(params.num_iterations
+                           if num_iterations is None
+                           else num_iterations),
         slot_budget=None if not params.bucket_slot_budget
         else int(params.bucket_slot_budget),
         solver=_spd_solver_mode(), precision=precision,
@@ -1087,16 +1166,24 @@ def warmup_train_als_bucketed(user_side: BucketedRatings,
         from predictionio_tpu.ops import aot
 
         precision = _als_precision_mode(params)
-        args, kw = _bucketed_call_args(user_side, item_side, params,
-                                       precision, abstract=True)
-        key = _bucketed_aot_key(args, kw)
-        if key in _aot_bucketed:
-            return True
-        compiled = aot.lower_compile(_get_bucketed_jit(), *args, **kw)
-        if compiled is None:
-            return False
-        _aot_bucketed.put(key, compiled)
-        return True
+        # with checkpointing active the chunked loop dispatches
+        # chunk-length scans (at most two distinct trip counts) —
+        # lower each so the warmed first train stays compile-free
+        # under the crash-safe lifecycle too
+        ok = True
+        for n in _checkpoint_chunk_lengths(params):
+            args, kw = _bucketed_call_args(user_side, item_side, params,
+                                           precision, abstract=True,
+                                           num_iterations=n)
+            key = _bucketed_aot_key(args, kw)
+            if key in _aot_bucketed:
+                continue
+            compiled = aot.lower_compile(_get_bucketed_jit(), *args, **kw)
+            if compiled is None:
+                ok = False
+                continue
+            _aot_bucketed.put(key, compiled)
+        return ok
     except Exception:
         return False
 
@@ -1122,7 +1209,29 @@ def train_als_bucketed(user_side: BucketedRatings,
     # with, so a warmed executable always matches this call's signature
     (_, _, u_t, i_t), kw = _bucketed_call_args(user_side, item_side,
                                                params, precision)
-    X, Y = _als_iterations_bucketed(X, Y, u_t, i_t, **kw)
+    ckpt = _maybe_checkpointer(
+        checkpoint_layout_bucketed(user_side, item_side), params,
+        kw["solver"], precision, dtype)
+    if ckpt is None:
+        X, Y = _als_iterations_bucketed(X, Y, u_t, i_t, **kw)
+    else:
+        # crash-safe lane: chunk-length scans with atomic checkpoints,
+        # preemption and the finite guard between them (byte-identical
+        # to the single scan — differential-gated)
+        import jax.numpy as jnp
+
+        from predictionio_tpu.workflow import checkpoint as _checkpoint
+
+        fdt = X.dtype
+
+        def run_iters(Xc, Yc, n):
+            return _als_iterations_bucketed(
+                Xc, Yc, u_t, i_t, **dict(kw, num_iterations=int(n)))
+
+        X, Y = _checkpoint.run_chunked(
+            run_iters, X, Y, int(params.num_iterations), ckpt,
+            to_host=lambda a: np.asarray(a, dtype=np.float32),
+            from_host=lambda a: jnp.asarray(a, dtype=fdt))
     # host factors always land fp32: persistence, serving and the eval
     # stack stay byte-compatible regardless of the training policy
     return (np.asarray(X, dtype=np.float32),
@@ -1183,14 +1292,35 @@ def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
     i_cols = jnp.asarray(item_side.cols)
     i_w = jnp.asarray(item_side.weights)
     i_m = jnp.asarray(item_side.mask)
-    X, Y = _als_iterations(
-        X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m,
+    solver = _spd_solver_mode()  # resolved per call, never at trace
+    kw = dict(
         lam=float(params.lambda_), alpha=float(params.alpha),
         implicit=bool(params.implicit_prefs),
-        num_iterations=int(params.num_iterations),
         block=None if not block else int(block),
-        solver=_spd_solver_mode(),  # resolved per call, never at trace
-        precision=precision, refine=bool(params.solve_refine))
+        solver=solver, precision=precision,
+        refine=bool(params.solve_refine))
+    ckpt = _maybe_checkpointer(
+        checkpoint_layout_uniform(user_side, item_side), params,
+        solver, precision, dtype)
+    if ckpt is None:
+        X, Y = _als_iterations(
+            X, Y, u_cols, u_w, u_m, i_cols, i_w, i_m,
+            num_iterations=int(params.num_iterations), **kw)
+    else:
+        # crash-safe lane (see train_als_bucketed)
+        from predictionio_tpu.workflow import checkpoint as _checkpoint
+
+        fdt = X.dtype
+
+        def run_iters(Xc, Yc, n):
+            return _als_iterations(
+                Xc, Yc, u_cols, u_w, u_m, i_cols, i_w, i_m,
+                num_iterations=int(n), **kw)
+
+        X, Y = _checkpoint.run_chunked(
+            run_iters, X, Y, int(params.num_iterations), ckpt,
+            to_host=lambda a: np.asarray(a, dtype=np.float32),
+            from_host=lambda a: jnp.asarray(a, dtype=fdt))
     # host factors always land fp32 (see train_als_bucketed)
     return (np.asarray(X, dtype=np.float32)[:n_u],
             np.asarray(Y, dtype=np.float32)[:n_i])

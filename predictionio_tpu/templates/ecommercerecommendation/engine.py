@@ -192,12 +192,18 @@ class ECommAlgorithm(P2LAlgorithm):
         vals = np.asarray(list(counts.values()), dtype=np.float32)
         rows, cols = keys[:, 0], keys[:, 1]
         n_u, n_i = len(user_map), len(item_map)
-        X, Y = _train_als_auto(
-            pad_ratings(rows, cols, vals, n_u, n_i),
-            pad_ratings(cols, rows, vals, n_i, n_u),
-            ALSParams(rank=p.rank, num_iterations=p.num_iterations,
-                      lambda_=p.lambda_,
-                      seed=0 if p.seed is None else p.seed))
+        from predictionio_tpu.workflow.checkpoint import (
+            bimap_fingerprint_scope)
+
+        # entity maps join the crash-safe checkpoint fingerprint
+        # (no-op while checkpointing is off)
+        with bimap_fingerprint_scope(user_map, item_map):
+            X, Y = _train_als_auto(
+                pad_ratings(rows, cols, vals, n_u, n_i),
+                pad_ratings(cols, rows, vals, n_i, n_u),
+                ALSParams(rank=p.rank, num_iterations=p.num_iterations,
+                          lambda_=p.lambda_,
+                          seed=0 if p.seed is None else p.seed))
         items = {item_map[iid]: item for iid, item in pd.items.items()}
         return ECommModel(p.rank, X, Y, user_map, item_map, items)
 

@@ -683,8 +683,15 @@ class ALSAlgorithm(_DeviceServingAlgo, P2LAlgorithm):
         # topology-aware: sharded over the (multi-host) mesh when one
         # exists, single-device otherwise (parallel/als_sharding.py)
         from predictionio_tpu.parallel.als_sharding import train_als_auto
+        from predictionio_tpu.workflow.checkpoint import (
+            bimap_fingerprint_scope)
 
-        X, Y = train_als_auto(pd.user_side, pd.item_side, self.params)
+        # the entity maps join the crash-safe checkpoint fingerprint:
+        # two stores with identical table shapes but different entity
+        # universes must never resume each other's checkpoints
+        # (no-op while checkpointing is off)
+        with bimap_fingerprint_scope(pd.user_map, pd.item_map):
+            X, Y = train_als_auto(pd.user_side, pd.item_side, self.params)
         return ALSModel(X, Y, pd.user_map, pd.item_map, pd.seen,
                         item_categories=pd.item_categories)
 
@@ -742,8 +749,12 @@ class ALSShardedAlgorithm(_DeviceServingAlgo, PAlgorithm):
     def train(self, ctx: ComputeContext,
               pd: PreparedData) -> ShardedALSModel:
         from predictionio_tpu.parallel.als_sharding import train_als_device
+        from predictionio_tpu.workflow.checkpoint import (
+            bimap_fingerprint_scope)
 
-        X, Y = train_als_device(pd.user_side, pd.item_side, self.params)
+        with bimap_fingerprint_scope(pd.user_map, pd.item_map):
+            X, Y = train_als_device(pd.user_side, pd.item_side,
+                                    self.params)
         return ShardedALSModel(
             X, Y, pd.user_side.n_rows, pd.user_side.n_cols,
             pd.user_map, pd.item_map, pd.seen,

@@ -209,6 +209,33 @@ def build_parser() -> argparse.ArgumentParser:
                             "PIO_ALS_PRECISION). bf16 stores/gathers "
                             "factors as bfloat16 with fp32 "
                             "normal-equation accumulation and solve")
+    train.add_argument("--checkpoint-every", type=int, default=None,
+                       metavar="N",
+                       help="crash-safe training: run the ALS iteration "
+                            "scan in chunks of N iterations and write an "
+                            "atomic checkpoint between chunks (env "
+                            "PIO_CHECKPOINT_EVERY; byte-identical to the "
+                            "default single-scan path). Requires "
+                            "--checkpoint-dir")
+    train.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                       help="directory for training checkpoints "
+                            "(npz blob + sha256/fingerprint manifest per "
+                            "step; defaults to $PIO_CHECKPOINT_DIR). "
+                            "SIGTERM/SIGINT then drain within one chunk: "
+                            "final checkpoint + clean exit")
+    train.add_argument("--checkpoint-keep", type=int, default=None,
+                       metavar="N",
+                       help="checkpoints retained, oldest dropped first "
+                            "(default 3; env PIO_CHECKPOINT_KEEP)")
+    train.add_argument("--resume", action="store_true",
+                       help="continue from the newest intact checkpoint "
+                            "in --checkpoint-dir whose input fingerprint "
+                            "(data layout + BiMaps + ALSParams + "
+                            "solver/precision statics) matches this run "
+                            "— final factors are byte-identical to an "
+                            "uninterrupted run; a mismatched checkpoint "
+                            "is refused loudly, torn files fall back to "
+                            "the previous intact one")
     _add_engine_args(train)
     train.add_argument("--batch", default="")
     train.add_argument("--skip-sanity-check", action="store_true")

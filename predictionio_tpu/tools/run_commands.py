@@ -115,6 +115,51 @@ def _apply_precision_flags(args) -> None:
         os.environ["PIO_BATCH_WINDOW"] = repr(float(batch_window))
 
 
+def _apply_checkpoint_flags(args) -> None:
+    """--checkpoint-every/-dir/-keep + --resume -> the PIO_CHECKPOINT_*
+    env vars the per-call resolver (workflow/checkpoint.py) reads —
+    the same env-as-truth discipline as the precision flags. When a
+    checkpoint dir is active, SIGTERM/SIGINT become graceful
+    preemption: finish the in-flight chunk, write a final checkpoint,
+    exit 0."""
+    every = getattr(args, "checkpoint_every", None)
+    if every is not None:
+        if every < 1:
+            raise SystemExit("--checkpoint-every must be >= 1")
+        os.environ["PIO_CHECKPOINT_EVERY"] = str(every)
+    cdir = getattr(args, "checkpoint_dir", None)
+    if cdir:
+        os.environ["PIO_CHECKPOINT_DIR"] = cdir
+    keep = getattr(args, "checkpoint_keep", None)
+    if keep is not None:
+        if keep < 1:
+            raise SystemExit("--checkpoint-keep must be >= 1")
+        os.environ["PIO_CHECKPOINT_KEEP"] = str(keep)
+    if getattr(args, "resume", False):
+        os.environ["PIO_RESUME"] = "1"
+    active_dir = os.environ.get("PIO_CHECKPOINT_DIR", "").strip()
+    if (every is not None or getattr(args, "resume", False)) \
+            and not active_dir:
+        raise SystemExit(
+            "--checkpoint-every/--resume require --checkpoint-dir "
+            "(or $PIO_CHECKPOINT_DIR)")
+    # graceful-drain handlers ONLY when a chunk cadence is actually
+    # configured here (flag/env every, or --resume): a dir alone runs
+    # the single-scan path with no boundary that would ever honor the
+    # stop flag, and a swallowed SIGTERM that logs "will checkpoint"
+    # while nothing will is worse than the default kill. (An engine
+    # variant may still set ALSParams.checkpoint_every on its own —
+    # checkpoints then land at every boundary and a hard kill stays
+    # resumable; only the signal-drain nicety needs the CLI/env knob.)
+    if active_dir and (
+            every is not None or getattr(args, "resume", False)
+            or os.environ.get("PIO_CHECKPOINT_EVERY", "").strip()):
+        from predictionio_tpu.workflow import checkpoint
+
+        checkpoint.clear_stop()
+        checkpoint.install_signal_handlers()
+
+
 def cmd_train(args) -> int:
     """Console train (Console.scala:834-842) -> create_workflow. A
     profile dir (--profile-dir / $PIO_PROFILE_DIR) captures a
@@ -128,6 +173,7 @@ def cmd_train(args) -> int:
 
     _apply_tracing_flags(args)
     _apply_precision_flags(args)
+    _apply_checkpoint_flags(args)
     try:
         # multi-host runtime (no-op on one host; parallel/distributed.py)
         from predictionio_tpu.parallel import distributed

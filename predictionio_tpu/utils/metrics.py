@@ -672,6 +672,14 @@ JIT_COMPILE_SECONDS = REGISTRY.counter(
 PROFILE_TRACES = REGISTRY.counter(
     "pio_profile_traces_total",
     "jax.profiler traces captured by profile_trace", ())
+TRAIN_DIVERGED = REGISTRY.counter(
+    "pio_train_diverged_total",
+    "Training runs aborted by the per-chunk non-finite factor guard "
+    "(the last intact checkpoint is retained)", ())
+TRAIN_CHECKPOINTS = REGISTRY.counter(
+    "pio_train_checkpoints_total",
+    "Training-checkpoint events by outcome (saved / resumed / "
+    "torn_skipped)", ("status",))
 
 
 class BoundedLabel:

@@ -5,7 +5,14 @@ Parity: reference ``core/src/main/scala/io/prediction/workflow/``
 spark-submit process boundary — the runner IS the TPU host process.
 """
 
+from predictionio_tpu.workflow.checkpoint import (
+    CheckpointMismatchError,
+    TrainCheckpointer,
+    TrainingDivergedError,
+    TrainingPreempted,
+)
 from predictionio_tpu.workflow.core_workflow import (
+    ModelIntegrityError,
     load_engine_factory,
     run_evaluation,
     run_train,
@@ -25,9 +32,14 @@ from predictionio_tpu.workflow.create_workflow import (
 )
 
 __all__ = [
+    "CheckpointMismatchError",
+    "ModelIntegrityError",
     "QueryServer",
     "ReloadDowngradeError",
     "ServerConfig",
+    "TrainCheckpointer",
+    "TrainingDivergedError",
+    "TrainingPreempted",
     "WorkflowConfig",
     "create_server",
     "create_workflow",

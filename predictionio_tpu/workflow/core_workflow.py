@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as _dt
+import hashlib
 import importlib
 import logging
 import pickle
@@ -36,13 +37,41 @@ def _now() -> _dt.datetime:
     return _dt.datetime.now(tz=_dt.timezone.utc)
 
 
+# model-blob integrity envelope: magic + sha256(payload) + payload.
+# Every Models backend stores the blob opaquely, so framing it here
+# covers them all at once; a torn/corrupted blob fails the digest at
+# load and deploy refuses loudly instead of unpickling garbage (or
+# worse, half a pickle stream "succeeding"). Pickle streams start with
+# b"\x80" for every protocol >= 2, so the magic cannot collide with a
+# legacy (pre-envelope) blob — those still load unframed.
+_MODEL_MAGIC = b"PIOM\x01"
+
+
+class ModelIntegrityError(RuntimeError):
+    """A persisted model blob failed its sha256 integrity check (torn
+    or corrupted write); refusing to deploy a garbage model."""
+
+
 def serialize_models(models: Sequence[Any]) -> bytes:
     """Persistable models -> blob (KryoInstantiator analog,
-    CoreWorkflow.scala:74-79)."""
-    return pickle.dumps(list(models), protocol=pickle.HIGHEST_PROTOCOL)
+    CoreWorkflow.scala:74-79), framed with a sha256 integrity
+    envelope checked by :func:`deserialize_models`."""
+    payload = pickle.dumps(list(models), protocol=pickle.HIGHEST_PROTOCOL)
+    return _MODEL_MAGIC + hashlib.sha256(payload).digest() + payload
 
 
 def deserialize_models(blob: bytes) -> List[Any]:
+    if blob[:len(_MODEL_MAGIC)] == _MODEL_MAGIC:
+        digest = blob[len(_MODEL_MAGIC):len(_MODEL_MAGIC) + 32]
+        payload = blob[len(_MODEL_MAGIC) + 32:]
+        if len(digest) != 32 \
+                or hashlib.sha256(payload).digest() != digest:
+            raise ModelIntegrityError(
+                "model blob failed its sha256 integrity check (torn "
+                "or corrupted write); refusing to load it — retrain "
+                "or redeploy a known-good engine instance")
+        return pickle.loads(payload)
+    # legacy blob from before the envelope: plain pickle
     return pickle.loads(blob)
 
 
@@ -115,6 +144,16 @@ def run_train(
         logger.info("Training completed successfully.")
         return instance_id
     except TrainingInterruption as e:
+        if getattr(e, "resumable", False):
+            # graceful preemption (workflow/checkpoint.py): a final
+            # checkpoint is on disk — mark the instance terminal
+            # (preempt->resume is a routine production loop; leaving
+            # INIT would accrete one phantom in-progress training per
+            # preemption) and propagate so the CLI reports where to
+            # resume from (still a clean exit, not a failure)
+            engine_instances.update(dataclasses.replace(
+                instance, status="INTERRUPTED", end_time=_now()))
+            raise
         logger.info("Training interrupted by %r.", e)
         return None
     except Exception:

@@ -693,6 +693,60 @@ class TestReloadHardening:
         finally:
             srv.stop()
 
+    def test_reload_of_resumed_train_matches_clean(self, mem_storage,
+                                                   tmp_path,
+                                                   monkeypatch):
+        """Crash-safe-training regression: a train that was PREEMPTED
+        at a chunk boundary and resumed to completion reloads exactly
+        like a clean train — same /reload response shape, same swap
+        accounting, and (training being deterministic under the
+        checkpoint fingerprint) byte-identical query results."""
+        from predictionio_tpu.workflow import (
+            QueryServer,
+            ServerConfig,
+            TrainingPreempted,
+            checkpoint,
+        )
+
+        _seed_app("recapp")
+        iid_clean = _train("recapp")
+        srv = QueryServer(ServerConfig(ip="127.0.0.1", port=0)).start(
+            undeploy_stale=False)
+        try:
+            status, clean_result = _post(srv.address, "/queries.json",
+                                         {"user": "u1", "num": 5})
+            assert status == 200 and clean_result["itemScores"]
+
+            # preempt a second train after its first chunk, then
+            # resume it to completion (the kill-9 lifecycle, in-process)
+            monkeypatch.setenv("PIO_CHECKPOINT_DIR",
+                               str(tmp_path / "ck"))
+            monkeypatch.setenv("PIO_CHECKPOINT_EVERY", "1")
+            checkpoint.request_stop()
+            try:
+                with pytest.raises(TrainingPreempted):
+                    _train("recapp")
+            finally:
+                checkpoint.clear_stop()
+            monkeypatch.setenv("PIO_RESUME", "1")
+            iid_resumed = _train("recapp")
+            monkeypatch.delenv("PIO_CHECKPOINT_DIR")
+
+            # the resumed-then-completed instance reloads exactly like
+            # a clean one: 200, correct swap bookkeeping, no downgrade
+            status, data = _post(srv.address, "/reload", {})
+            assert status == 200
+            assert data["engineInstanceId"] == iid_resumed
+            assert data["swappedFrom"] == iid_clean
+            assert data["swappedTo"] == iid_resumed
+            status, resumed_result = _post(srv.address, "/queries.json",
+                                           {"user": "u1", "num": 5})
+            assert status == 200
+            assert resumed_result["itemScores"] == \
+                clean_result["itemScores"]
+        finally:
+            srv.stop()
+
 
 @pytest.fixture
 def foldin_env(monkeypatch):
