@@ -805,6 +805,74 @@ def serving_bench(X: np.ndarray, Y: np.ndarray, n_queries: int = 300,
     }
 
 
+def seqrec_train_bench(n_users: int = 2000, n_items: int = 500,
+                       min_len: int = 6, max_len: int = 64,
+                       rank: int = 64, n_layers: int = 2,
+                       n_heads: int = 4, num_steps: int = 400,
+                       batch_size: int = 256, seed: int = 13) -> dict:
+    """Training throughput of the sequentialrec encoder (ISSUE 14
+    bench lane): tokens/s/chip of the bucketed ``lax.scan`` training
+    programs plus the fresh-jit compile cost, measured the PR-11 way —
+    run 1 pays every per-bucket compile, run 2 hits the jit cache, so
+    ``compile_sec = run1 - run2`` and the steady run is the throughput
+    number. A token here is one padded sequence position processed by
+    one optimizer step (batch x bucket-length, the ``plan_steps``
+    accounting shared with the trainer)."""
+    from predictionio_tpu.ops.seqrec import (
+        SeqRecParams,
+        bucket_sequences,
+        encode_users,
+        plan_steps,
+        train_seqrec,
+    )
+
+    rng = np.random.default_rng(seed)
+    seqs = []
+    for _ in range(n_users):
+        start = int(rng.integers(0, n_items))
+        n = int(rng.integers(min_len, max_len))
+        seqs.append(((start + np.arange(n)) % n_items).astype(np.int64))
+    params = SeqRecParams(rank=rank, n_layers=n_layers, n_heads=n_heads,
+                          max_seq_len=max_len, num_steps=num_steps,
+                          batch_size=batch_size, n_negatives=128,
+                          seed=seed)
+    buckets = bucket_sequences(seqs, max_len=max_len)
+    tokens = sum(steps * bs * b.seq_len
+                 for b, (steps, bs) in zip(buckets,
+                                           plan_steps(buckets, params)))
+
+    t0 = time.perf_counter()
+    theta, losses = train_seqrec(buckets, n_items, params)
+    first_sec = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    theta, losses = train_seqrec(buckets, n_items, params)
+    steady_sec = time.perf_counter() - t0
+    assert all(np.isfinite(losses))
+
+    t0 = time.perf_counter()
+    encode_users(theta, buckets, n_users, params)
+    encode_sec = time.perf_counter() - t0
+
+    return _stamp_device({
+        "n_users": n_users, "n_items": n_items,
+        "rank": rank, "n_layers": n_layers, "n_heads": n_heads,
+        "num_steps": len(losses), "batch_size": batch_size,
+        "buckets": [(len(b), b.seq_len) for b in buckets],
+        "tokens_trained": int(tokens),
+        "train_sec": round(steady_sec, 3),
+        "tokens_per_sec": round(tokens / steady_sec, 1),
+        "fresh_jit_compile_sec": round(max(0.0, first_sec - steady_sec),
+                                       3),
+        "encode_all_users_sec": round(encode_sec, 3),
+        "loss_first": round(float(losses[0]), 4),
+        "loss_last": round(float(losses[-1]), 4),
+        "note": ("tokens = padded positions x optimizer steps across "
+                 "the power-of-two length buckets; steady run hits the "
+                 "per-bucket jit cache, the delta vs run 1 is the "
+                 "fresh-compile cost"),
+    })
+
+
 def serving_load_bench(n_users: int = 256, n_items: int = 128,
                        rank: int = 8,
                        levels: tuple = (100.0, 250.0, 500.0, 1000.0),
@@ -812,7 +880,8 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
                        slo_p99_ms: float = 250.0,
                        seed: int = 23,
                        serve_precision: Optional[str] = None,
-                       serve_kernel: Optional[str] = None) -> dict:
+                       serve_kernel: Optional[str] = None,
+                       template: str = "recommendation") -> dict:
     """Closed-loop HTTP load generator against a DEPLOYED query server
     — the PR-10 continuous-batching acceptance bench (ROADMAP item 2:
     sub-10ms p50 at sustained QPS; BENCH_r03's thread-per-request path
@@ -834,7 +903,13 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
       histogram's exemplar trace + the slow-query log, so a regressed
       percentile links straight to the trace that cost it;
     - the dispatcher's ``batcher_stats`` (dispatch triggers, batch fill,
-      queue-depth percentiles) for the served lanes."""
+      queue-depth percentiles) for the served lanes.
+
+    ``template`` picks the deployed engine: ``recommendation`` (ALS,
+    the historical lane) or ``sequentialrec`` (the SASRec next-item
+    template — its user-vector store serves through the SAME DeviceTopK
+    path, so the sweep proves the whole continuous-batching plane for
+    the sequence-model family too)."""
     import datetime as _dt
     import http.client
     import os
@@ -885,25 +960,56 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
         le = storage_mod.get_levents()
         le.init(aid)
         t0_evt = _dt.datetime(2024, 1, 1, tzinfo=_dt.timezone.utc)
-        le.insert_batch([
-            Event(event="rate", entity_type="user", entity_id=f"u{u}",
-                  target_entity_type="item",
-                  target_entity_id=f"i{int(i)}",
-                  properties={"rating": float(rng.integers(3, 6))},
-                  event_time=t0_evt)
-            for u in range(n_users)
-            for i in rng.choice(n_items, size=6, replace=False)], aid)
+        if template == "sequentialrec":
+            from predictionio_tpu.ops.seqrec import SeqRecParams
+            from predictionio_tpu.templates.sequentialrec import (
+                DataSourceParams as SeqDSParams,
+                SeqPreparatorParams,
+                engine_factory as seq_engine_factory,
+            )
 
-        engine = engine_factory()
-        params = EngineParams(
-            data_source_params=("",
-                                DataSourceParams(app_name="loadbench")),
-            algorithm_params_list=[
-                ("als", ALSParams(rank=rank, num_iterations=2,
-                                  seed=seed))])
-        cfg = WorkflowConfig(
-            engine_factory="predictionio_tpu.templates.recommendation"
-                           ":engine_factory")
+            le.insert_batch([
+                Event(event="view", entity_type="user",
+                      entity_id=f"u{u}", target_entity_type="item",
+                      target_entity_id=f"i{(int(start) + j) % n_items}",
+                      event_time=t0_evt + _dt.timedelta(minutes=j))
+                for u, start in enumerate(
+                    rng.integers(0, n_items, size=n_users))
+                for j in range(6)], aid)
+            engine = seq_engine_factory()
+            params = EngineParams(
+                data_source_params=("", SeqDSParams(
+                    app_name="loadbench")),
+                preparator_params=("", SeqPreparatorParams(
+                    max_seq_len=16)),
+                algorithm_params_list=[
+                    ("seqrec", SeqRecParams(
+                        rank=rank, n_layers=2, n_heads=2,
+                        max_seq_len=16, num_steps=60, batch_size=64,
+                        n_negatives=32, seed=seed))])
+            cfg = WorkflowConfig(
+                engine_factory="predictionio_tpu.templates."
+                               "sequentialrec:engine_factory")
+        else:
+            le.insert_batch([
+                Event(event="rate", entity_type="user",
+                      entity_id=f"u{u}", target_entity_type="item",
+                      target_entity_id=f"i{int(i)}",
+                      properties={"rating": float(rng.integers(3, 6))},
+                      event_time=t0_evt)
+                for u in range(n_users)
+                for i in rng.choice(n_items, size=6, replace=False)],
+                aid)
+            engine = engine_factory()
+            params = EngineParams(
+                data_source_params=("", DataSourceParams(
+                    app_name="loadbench")),
+                algorithm_params_list=[
+                    ("als", ALSParams(rank=rank, num_iterations=2,
+                                      seed=seed))])
+            cfg = WorkflowConfig(
+                engine_factory="predictionio_tpu.templates."
+                               "recommendation:engine_factory")
         iid = run_train(engine, params, new_engine_instance(cfg, params),
                         ctx=ComputeContext())
         assert iid is not None
@@ -1027,6 +1133,7 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
         dev_report = serving_mod.device_report()
 
         return _stamp_device({
+            "template": template,
             "clients": clients,
             "duration_sec_per_level": duration_sec,
             "serve_precision": serve_precision or "default",
@@ -2154,6 +2261,23 @@ def main(smoke: bool = False) -> None:
         **({"n_users": 96, "n_items": 64, "levels": (50.0, 100.0),
             "duration_sec": 1.0, "clients": 4} if smoke else {}))
 
+    # the sequentialrec lanes (ISSUE 14): encoder training tokens/s +
+    # the SAME closed-loop serving sweep against a deployed
+    # sequentialrec engine (its user-vector store rides DeviceTopK, so
+    # the zero-compile gate applies unchanged), + the next-item quality
+    # gate (loss decreases; beats the popularity baseline)
+    seqrec_train = seqrec_train_bench(
+        **({"n_users": 200, "n_items": 60, "max_len": 16,
+            "rank": 16, "num_steps": 60, "batch_size": 32}
+           if smoke else {}))
+    serving_load_seqrec = serving_load_bench(
+        template="sequentialrec",
+        **({"n_users": 96, "n_items": 64, "levels": (50.0, 100.0),
+            "duration_sec": 1.0, "clients": 4} if smoke else {}))
+    seqrec_quality = bench_quality.run_seqrec_check(
+        **({"n_users": 80, "n_items": 50, "num_steps": 150}
+           if smoke else {}))
+
     # int8 store + fused top-k kernel vs the bf16 einsum lane (ROADMAP
     # item 4 acceptance: >=2x QPS + ~4x catalog per chip on device;
     # CPU smoke proves the wiring and the zero-compile gate only)
@@ -2238,6 +2362,9 @@ def main(smoke: bool = False) -> None:
         "text_classification": text_quality,
         "serving": serving,
         "serving_load": serving_load,
+        "seqrec_train": seqrec_train,
+        "serving_load_sequentialrec": serving_load_seqrec,
+        "seqrec_quality": seqrec_quality,
         "serving_quantized": serving_quant,
         "instrumentation_overhead": overhead,
         "tracing_overhead": tracing_overhead,
@@ -2281,6 +2408,16 @@ def main(smoke: bool = False) -> None:
             serving_load["max_sustainable_qps"],
         "serving_load_zero_compiles":
             serving_load["zero_compile_steady_state"],
+        "seqrec_train_tokens_per_sec":
+            seqrec_train["tokens_per_sec"],
+        "seqrec_fresh_jit_compile_sec":
+            seqrec_train["fresh_jit_compile_sec"],
+        "seqrec_serving_p50_ms": serving_load_seqrec["p50_ms"],
+        "seqrec_serving_zero_compiles":
+            serving_load_seqrec["zero_compile_steady_state"],
+        "seqrec_precision_at_10": seqrec_quality["precision_at_k"],
+        "seqrec_beats_popularity":
+            seqrec_quality["beats_popularity"],
         "serving_int8_qps_ratio_vs_bf16":
             serving_quant["qps_ratio_int8_vs_bf16"],
         "serving_int8_catalog_ratio_vs_fp32":

@@ -120,10 +120,23 @@ class FoldInConsumer:
     """
 
     def __init__(self, model: Any, config: FoldInConfig,
-                 als_params: ALSParams):
+                 als_params: Optional[ALSParams] = None):
         self._model = model
         self._cfg = config
         self._params = als_params
+        # model-provided solve hook (e.g. the sequentialrec template's
+        # re-encode): when present it replaces the ALS half-step, and
+        # ``foldin_time_ordered`` asks the gather to hand histories in
+        # EVENT-TIME order (sequence encoders are order-sensitive; the
+        # ALS normal equations are not)
+        self._fold_hook = getattr(model, "fold_in_rows", None)
+        self._ordered = bool(getattr(model, "foldin_time_ordered",
+                                     False))
+        if self._fold_hook is None and als_params is None:
+            raise ValueError(
+                "FoldInConsumer needs either ALSParams (the training "
+                "half-step lane) or a model with fold_in_rows (the "
+                "model-encoder lane)")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._cursor: Optional[Dict] = None
@@ -310,8 +323,9 @@ class FoldInConsumer:
         cfg = self._cfg
         item_map = self._model.item_map
         le = self._levents()
-        per_user: Dict[str, Tuple[List[int], List[float]]] = {
-            uid: ([], []) for uid in user_ids}
+        per_user: Dict[str, Tuple[List[int], List[float], List[float]]] \
+            = {uid: ([], [], []) for uid in user_ids}
+        ordered = self._ordered
 
         def take(bucket, e) -> None:
             idx = item_map.get(e.target_entity_id)
@@ -326,6 +340,8 @@ class FoldInConsumer:
                 val = cfg.default_value
             bucket[0].append(int(idx))
             bucket[1].append(val)
+            if ordered:
+                bucket[2].append(e.event_time.timestamp())
 
         find_kwargs = dict(
             channel_id=self._scope[1], entity_type=cfg.entity_type,
@@ -346,12 +362,19 @@ class FoldInConsumer:
         cols_list: List[np.ndarray] = []
         vals_list: List[np.ndarray] = []
         for uid in user_ids:
-            cols, vals = per_user[uid]
+            cols, vals, times = per_user[uid]
             if not cols:
                 continue
             kept_ids.append(uid)
-            cols_list.append(np.asarray(cols, dtype=np.int64))
-            vals_list.append(np.asarray(vals, dtype=np.float32))
+            c = np.asarray(cols, dtype=np.int64)
+            v = np.asarray(vals, dtype=np.float32)
+            if ordered:
+                # stable: equal timestamps keep the scan's arrival order
+                o = np.argsort(np.asarray(times, dtype=np.float64),
+                               kind="stable")
+                c, v = c[o], v[o]
+            cols_list.append(c)
+            vals_list.append(v)
         return kept_ids, cols_list, vals_list
 
     def _fold(self) -> None:
@@ -374,15 +397,28 @@ class FoldInConsumer:
                 server = model.device_server()
                 with span("foldin.solve",
                           attributes={"users": len(kept_ids)}) as ssp:
-                    rows = fold_in_users(server.item_factors, cols_list,
-                                         vals_list, self._params,
-                                         max_len=self._cfg.max_len)
-                    # the solve's flight record (device-telemetry PR
-                    # 12): pin it to the span so a slow fold's trace
-                    # names its bucket shape + device time, and keep
-                    # the device µs for stats()
-                    rec = device_telemetry.last_record() \
-                        if device_telemetry.enabled() else None
+                    if self._fold_hook is not None:
+                        # model-encoder lane: re-encode the touched
+                        # users' (time-ordered) sequences on device.
+                        # The hook records no flight record of its own,
+                        # so do NOT consult last_record() here — under
+                        # live traffic it would hand back a concurrent
+                        # QUERY dispatch's record and publish a wrong
+                        # lane/deviceUs as the fold solve's
+                        rows = self._fold_hook(cols_list, vals_list)
+                        rec = None
+                    else:
+                        rows = fold_in_users(server.item_factors,
+                                             cols_list, vals_list,
+                                             self._params,
+                                             max_len=self._cfg.max_len)
+                        # the solve's flight record (device-telemetry
+                        # PR 12): fold_in_users just recorded the
+                        # "foldin"-lane dispatch; pin it to the span so
+                        # a slow fold's trace names its bucket shape +
+                        # device time, and keep the µs for stats()
+                        rec = device_telemetry.last_record() \
+                            if device_telemetry.enabled() else None
                     if rec is not None:
                         if ssp is not None:
                             ssp.attributes["dispatch"] = rec
@@ -494,17 +530,22 @@ def attach_foldin(deployment: Any,
             "fold-in has nothing to patch")
     i, model = target
     _, aparams = deployment.engine_params.algorithm_params_list[i]
-    if not isinstance(aparams, ALSParams):
+    has_hook = callable(getattr(model, "fold_in_rows", None))
+    if not has_hook and not isinstance(aparams, ALSParams):
         # refuse rather than guess: the fold-in solve is the training
         # half-step, and hyperparameters inferred by getattr-with-
         # defaults could silently solve a DIFFERENT objective than the
-        # one the deployed factors were trained under
+        # one the deployed factors were trained under. A model that
+        # carries its OWN solve (fold_in_rows — e.g. the sequentialrec
+        # re-encode, whose hyperparameters travel inside the model)
+        # needs no ALSParams.
         raise ValueError(
             "--foldin on: the deployed algorithm's params "
-            f"({type(aparams).__name__}) are not ALSParams, so the "
-            "fold-in solve cannot take its hyperparameters from "
-            "training; give the algorithm ALSParams (or a subclass) "
-            "to enable online fold-in")
+            f"({type(aparams).__name__}) are not ALSParams and the "
+            "model has no fold_in_rows hook, so the fold-in solve "
+            "cannot take its hyperparameters from training; give the "
+            "algorithm ALSParams (or a subclass), or a model-side "
+            "fold_in_rows encoder, to enable online fold-in")
     dsp = deployment.engine_params.data_source_params[1]
     app_name = getattr(dsp, "app_name", None)
     if not app_name:
@@ -523,7 +564,9 @@ def attach_foldin(deployment: Any,
     if count_threshold is not None:
         kwargs["count_threshold"] = int(count_threshold)
     config = FoldInConfig.from_env(**kwargs)
-    return FoldInConsumer(model, config, aparams)
+    return FoldInConsumer(
+        model, config,
+        aparams if isinstance(aparams, ALSParams) else None)
 
 
 __all__ = ["FoldInConfig", "FoldInConsumer", "attach_foldin"]

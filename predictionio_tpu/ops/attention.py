@@ -28,11 +28,20 @@ import functools
 from typing import Optional
 
 
-def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
+def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None,
+                  key_padding_mask=None):
     """Dense multi-head attention oracle: softmax(QKᵀ·scale [+mask]) V.
 
     ``q/k/v: [B, H, L, D]``. Used as the numerical reference for the ring
     variant and fine on its own for short sequences.
+
+    ``key_padding_mask``: optional ``[B, L_k]`` (1/True = real key,
+    0/False = padding). Masked keys score ``-inf`` before the softmax,
+    composed with the causal mask — ragged sequences batched into one
+    padded table must not attend their pad rows. A query row whose
+    visible keys are ALL masked outputs exact zeros (safe softmax)
+    instead of NaN; without a mask the historical code path is
+    untouched.
     """
     import jax
     import jax.numpy as jnp
@@ -45,19 +54,33 @@ def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
         qpos = jnp.arange(lq)[:, None]
         kpos = jnp.arange(lk)[None, :]
         s = jnp.where(qpos >= kpos, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
+    if key_padding_mask is None:
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                          precision=jax.lax.Precision.HIGHEST)
+    kp = jnp.asarray(key_padding_mask)
+    s = jnp.where(kp[:, None, None, :].astype(bool), s, -jnp.inf)
+    # safe softmax: a fully-masked query row (all -inf) outputs 0, the
+    # same convention as the ring variant's zero-denominator rows
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(s - m))
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / jnp.where(denom == 0.0, 1.0, denom)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v,
                       precision=jax.lax.Precision.HIGHEST)
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
-                          causal: bool, scale: float):
+def _ring_attention_local(q, k, v, kv_mask=None, *, axis_name: str,
+                          axis_size: int, causal: bool, scale: float):
     """Per-device ring attention body (runs under shard_map).
 
     ``q/k/v: [B, H, L_local, D]`` — this device's sequence shard. Each of
     the ``axis_size`` steps attends Q against the currently-held K/V block,
     folds the result into online-softmax accumulators, then rotates K/V to
-    the next device on the ring.
+    the next device on the ring. ``kv_mask`` (``[B, L_local]``, optional)
+    is this device's slice of the key-padding mask; it rotates around the
+    ring WITH its K/V block so each fold masks the block it actually
+    holds.
     """
     import jax
     import jax.numpy as jnp
@@ -81,7 +104,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
 
     qpos = my_idx * L + jnp.arange(L)  # global query positions
 
-    def fold(i, o, l, m, k_blk, v_blk):
+    def fold(i, o, l, m, k_blk, v_blk, mask_blk):
         """Fold the currently-held K/V block into the accumulators.
         The block held at step i originated on device (my_idx - i) % n."""
         src = (my_idx - i) % axis_size
@@ -91,6 +114,9 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
             kpos = src * L + jnp.arange(L)
             mask = qpos[:, None] >= kpos[None, :]
             s = jnp.where(mask[None, None], s, -jnp.inf)
+        if mask_blk is not None:
+            s = jnp.where(mask_blk[:, None, None, :].astype(bool),
+                          s, -jnp.inf)
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_blk)
         corr = jnp.where(jnp.isneginf(m_new), 0.0, jnp.exp(m - m_new))
@@ -104,27 +130,44 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
     # fori_loop: one compiled step regardless of ring size. Runs n-1
     # fold+rotate steps; the LAST fold is peeled outside the loop so no
     # dead final rotation ships K/V over ICI just to be discarded.
-    def body(i, carry):
-        o, l, m, k_blk, v_blk = carry
-        o, l, m = fold(i, o, l, m, k_blk, v_blk)
-        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return o, l, m, k_blk, v_blk
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
-    o, l, m, k_last, v_last = jax.lax.fori_loop(
-        0, axis_size - 1, body, (o0, l0, m0, k, v))
-    o, l, m = fold(axis_size - 1, o, l, m, k_last, v_last)
-    # rows with no visible keys (can't happen causally: self-block always
-    # visible) keep denominator 0 -> output 0
+    if kv_mask is None:
+        def body(i, carry):
+            o, l, m, k_blk, v_blk = carry
+            o, l, m = fold(i, o, l, m, k_blk, v_blk, None)
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            return o, l, m, k_blk, v_blk
+
+        o, l, m, k_last, v_last = jax.lax.fori_loop(
+            0, axis_size - 1, body, (o0, l0, m0, k, v))
+        o, l, m = fold(axis_size - 1, o, l, m, k_last, v_last, None)
+    else:
+        def body(i, carry):
+            o, l, m, k_blk, v_blk, mask_blk = carry
+            o, l, m = fold(i, o, l, m, k_blk, v_blk, mask_blk)
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
+            return o, l, m, k_blk, v_blk, mask_blk
+
+        o, l, m, k_last, v_last, mask_last = jax.lax.fori_loop(
+            0, axis_size - 1, body,
+            (o0, l0, m0, k, v, kv_mask.astype(jnp.float32)))
+        o, l, m = fold(axis_size - 1, o, l, m, k_last, v_last, mask_last)
+    # rows with no visible keys (every key padding-masked; can't happen
+    # causally WITHOUT a mask: the self-block is always visible) keep
+    # denominator 0 -> output 0, matching mha_reference's safe softmax
     denom = jnp.where(l == 0.0, 1.0, l)
     return (o / denom[..., None]).astype(q.dtype)
 
 
-def _sp_program(local_body, mesh, axis_name: str):
+def _sp_program(local_body, mesh, axis_name: str, with_mask: bool = False):
     """shard_map + jit a per-device attention body with q/k/v/out all
     sequence-sharded over ``axis_name`` — the shared scaffolding of both
-    SP schemes."""
+    SP schemes. ``with_mask`` adds a fourth ``[B, L]`` input sharded
+    over the same sequence axis (the key-padding mask)."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -132,16 +175,19 @@ def _sp_program(local_body, mesh, axis_name: str):
     if shard_map is None:  # older jax
         from jax.experimental.shard_map import shard_map
 
+    in_specs = (P(None, None, axis_name, None),) * 3
+    if with_mask:
+        in_specs = in_specs + (P(None, axis_name),)
     fn = shard_map(
         local_body,
         mesh=mesh,
-        in_specs=(P(None, None, axis_name, None),) * 3,
+        in_specs=in_specs,
         out_specs=P(None, None, axis_name, None),
     )
     return jax.jit(fn)
 
 
-def _sp_call(program, q, k, v, mesh, axis_name: str):
+def _sp_call(program, q, k, v, mesh, axis_name: str, kv_mask=None):
     """Stage the global arrays sequence-sharded and run the program."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -153,40 +199,61 @@ def _sp_call(program, q, k, v, mesh, axis_name: str):
             f"{axis_name} of size {n}")
     spec = NamedSharding(mesh, P(None, None, axis_name, None))
     q, k, v = (jax.device_put(x, spec) for x in (q, k, v))
-    return program(q, k, v)
+    if kv_mask is None:
+        return program(q, k, v)
+    import jax.numpy as jnp
+
+    mask_spec = NamedSharding(mesh, P(None, axis_name))
+    kv_mask = jax.device_put(jnp.asarray(kv_mask, dtype=jnp.float32),
+                             mask_spec)
+    return program(q, k, v, kv_mask)
 
 
 @functools.lru_cache(maxsize=64)
-def _ring_fn(mesh, axis_name: str, causal: bool, scale: float):
-    """Cached jitted shard_map program per (mesh, axis, causal, scale) —
-    repeated calls (e.g. one per layer per step) hit the jit cache
-    instead of retracing (same pattern as parallel/als_sharding.py)."""
-    return _sp_program(
-        functools.partial(_ring_attention_local, axis_name=axis_name,
-                          axis_size=mesh.shape[axis_name], causal=causal,
-                          scale=scale),
-        mesh, axis_name)
+def _ring_fn(mesh, axis_name: str, causal: bool, scale: float,
+             masked: bool = False):
+    """Cached jitted shard_map program per (mesh, axis, causal, scale,
+    masked) — repeated calls (e.g. one per layer per step) hit the jit
+    cache instead of retracing (same pattern as
+    parallel/als_sharding.py)."""
+    body = functools.partial(_ring_attention_local, axis_name=axis_name,
+                             axis_size=mesh.shape[axis_name],
+                             causal=causal, scale=scale)
+    if not masked:
+        # the UNMASKED program keeps the historical three-operand
+        # signature (cached executables, HLO-inspection tests)
+        return _sp_program(body, mesh, axis_name)
+    return _sp_program(body, mesh, axis_name, with_mask=True)
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = "data",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   key_padding_mask=None):
     """Sequence-parallel attention over ``mesh[axis_name]``.
 
     ``q/k/v: [B, H, L, D]`` global arrays whose ``L`` must divide evenly
     by the mesh axis size; each device computes its sequence shard while
     K/V blocks rotate around the ring (ICI ppermute). Returns the global
     ``[B, H, L, D]`` result matching :func:`mha_reference`.
+
+    ``key_padding_mask``: optional ``[B, L]`` (1 = real, 0 = padding),
+    sequence-sharded like K/V; the mask block rotates around the ring
+    with its K/V block, so padded keys score ``-inf`` in every fold —
+    identical semantics to the dense oracle's mask.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return _sp_call(_ring_fn(mesh, axis_name, causal, float(scale)),
-                    q, k, v, mesh, axis_name)
+    return _sp_call(
+        _ring_fn(mesh, axis_name, causal, float(scale),
+                 key_padding_mask is not None),
+        q, k, v, mesh, axis_name, kv_mask=key_padding_mask)
 
 
 # ---------------------------------------------------------------------------
 # Ulysses-style all-to-all sequence parallelism
 # ---------------------------------------------------------------------------
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+def _ulysses_local(q, k, v, kv_mask=None, *, axis_name: str, causal: bool,
+                   scale: float):
     """Per-device body: all_to_all swaps the sequence shard for a HEAD
     shard, so each device runs DENSE attention for its head group over
     the FULL sequence (causal masking is then trivially exact), and a
@@ -197,7 +264,9 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     attention — the better fit when heads divide the mesh axis and the
     full [L, L] per-head-group score block fits HBM; the ring wins on
     memory for extreme L (its online softmax never materializes
-    [L, L])."""
+    [L, L]). The key-padding mask (``[B, L/P]`` per device) has no head
+    axis to trade, so it all_gathers to the full ``[B, L]`` — tiny next
+    to K/V — and feeds the dense oracle's mask path directly."""
     import jax
 
     def swap(x, fwd: bool):
@@ -207,28 +276,37 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
             concat_axis=2 if fwd else 1, tiled=True)
 
     qh, kh, vh = swap(q, True), swap(k, True), swap(v, True)
-    out = mha_reference(qh, kh, vh, causal=causal, scale=scale)
+    full_mask = None
+    if kv_mask is not None:
+        full_mask = jax.lax.all_gather(kv_mask, axis_name, axis=1,
+                                       tiled=True)
+    out = mha_reference(qh, kh, vh, causal=causal, scale=scale,
+                        key_padding_mask=full_mask)
     return swap(out, False)
 
 
 @functools.lru_cache(maxsize=64)
-def _ulysses_fn(mesh, axis_name: str, causal: bool, scale: float):
-    return _sp_program(
-        functools.partial(_ulysses_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
-        mesh, axis_name)
+def _ulysses_fn(mesh, axis_name: str, causal: bool, scale: float,
+                masked: bool = False):
+    body = functools.partial(_ulysses_local, axis_name=axis_name,
+                             causal=causal, scale=scale)
+    if not masked:
+        return _sp_program(body, mesh, axis_name)
+    return _sp_program(body, mesh, axis_name, with_mask=True)
 
 
 def ulysses_attention(q, k, v, mesh, axis_name: str = "data",
                       causal: bool = False,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      key_padding_mask=None):
     """All-to-all sequence-parallel attention over ``mesh[axis_name]``
     (DeepSpeed-Ulysses layout; see PAPERS.md): inputs/outputs are
     sequence-sharded ``[B, H, L, D]`` exactly like
     :func:`ring_attention`, but internally each device attends its
     H/P-head group over the full sequence between two all_to_all
     collectives. Requires both ``L`` and ``H`` divisible by the axis
-    size. Numerics match :func:`mha_reference`."""
+    size. Numerics match :func:`mha_reference`, including the optional
+    ``[B, L]`` ``key_padding_mask`` (1 = real, 0 = padding)."""
     n = mesh.shape[axis_name]
     if q.shape[1] % n:
         raise ValueError(
@@ -236,5 +314,7 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "data",
             f"{axis_name} of size {n} — use ring_attention for "
             "head counts below the mesh size")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return _sp_call(_ulysses_fn(mesh, axis_name, causal, float(scale)),
-                    q, k, v, mesh, axis_name)
+    return _sp_call(
+        _ulysses_fn(mesh, axis_name, causal, float(scale),
+                    key_padding_mask is not None),
+        q, k, v, mesh, axis_name, kv_mask=key_padding_mask)

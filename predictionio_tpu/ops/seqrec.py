@@ -1,0 +1,498 @@
+"""Sequential-recommendation ops: a SASRec-style next-item encoder on
+the attention kernels (ROADMAP item 1 — the first workload that consumes
+``ops/attention.py``).
+
+The model is a small causal transformer over each user's time-ordered
+item sequence (Kang & McAuley's SASRec shape, the TurboGR /
+generative-recommendation direction from PAPERS.md):
+
+- learned item + position embeddings (tied item table: the same ``[M,
+  D]`` matrix embeds inputs AND scores the output softmax — so a trained
+  model serves through the standard factor-store top-k path: user vector
+  = the encoder's hidden state at the last real position, item vectors =
+  the embedding table, score = dot product);
+- N pre-LN blocks of multi-head CAUSAL self-attention
+  (:func:`~predictionio_tpu.ops.attention.mha_reference` with the
+  key-padding mask — ragged histories batch into padded tables without
+  attending pad rows) + a pointwise FFN;
+- trained by one jitted ``lax.scan`` over optimizer steps (Adam,
+  sampled-softmax over the item vocabulary: the full [B, L, M] logits
+  never materialize);
+- sequences are grouped into POWER-OF-TWO length buckets (the
+  ``ops/als.PAD_MULTIPLE`` discipline): each bucket is one static-shape
+  program, so a catalog of ragged histories compiles a handful of
+  programs instead of one per distinct length.
+
+Mesh lane: when a mesh is present the per-layer attention runs the
+sequence-parallel kernels (``ring_attention`` / ``ulysses_attention``)
+instead of the dense oracle — Ulysses when the head count divides the
+mesh axis, the ring otherwise. The bucketed lengths are powers of two,
+so divisibility by a 2^k mesh axis holds whenever L >= axis size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core.base import Params
+from predictionio_tpu.ops.als import PAD_MULTIPLE
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqRecParams(Params):
+    """SASRec-style hyperparameters.
+
+    ``rank`` doubles as the embedding/model width so a trained model
+    drops into the same ``[N, R] x [M, R]`` serving stores ALS uses.
+    ``sp_mode`` selects the sequence-parallel attention lane when a mesh
+    is present: ``auto`` (ulysses when heads divide the mesh axis, ring
+    otherwise), ``ring``, ``ulysses``, or ``off`` (dense attention even
+    on a mesh)."""
+
+    rank: int = 32
+    n_layers: int = 2
+    n_heads: int = 2
+    max_seq_len: int = 32
+    num_steps: int = 300
+    batch_size: int = 128
+    learning_rate: float = 1e-3
+    n_negatives: int = 64
+    ffn_mult: int = 2
+    l2: float = 0.0
+    seed: int = 0
+    sp_mode: str = "auto"
+
+
+@dataclasses.dataclass
+class SequenceBucket:
+    """One static-shape batch of same-length-class sequences.
+
+    ``rows[i]`` is the ORIGINAL row index (user index) of padded row i;
+    ``ids`` are item indices (0-padded — pad slots are masked, never
+    attended or scored); ``mask`` is 1.0 on real positions."""
+
+    rows: np.ndarray   # int64 [B]
+    ids: np.ndarray    # int32 [B, L]
+    mask: np.ndarray   # float32 [B, L]
+
+    @property
+    def seq_len(self) -> int:
+        return int(self.ids.shape[1])
+
+    def __len__(self) -> int:
+        return int(self.ids.shape[0])
+
+
+def length_bucket(n: int, lo: int = PAD_MULTIPLE) -> int:
+    """The power-of-two length class ``n`` pads to (min ``lo`` — the
+    same pad discipline as the ALS tables: ``ops/als.PAD_MULTIPLE``).
+    One ladder definition: delegates to the serving bucket rounder so
+    train-time length classes and serve-time shape buckets can never
+    diverge."""
+    from predictionio_tpu.ops.serving import bucket_size
+
+    return bucket_size(n, lo)
+
+
+def bucket_sequences(seqs: Sequence[np.ndarray],
+                     max_len: Optional[int] = None) -> List[SequenceBucket]:
+    """Group ragged per-user item sequences into power-of-two length
+    buckets. Sequences longer than ``max_len`` keep their LAST
+    ``max_len`` items (the most recent history is the signal — same
+    keep-the-informative-suffix convention SASRec trains with). Empty
+    sequences are dropped (their rows simply appear in no bucket).
+    Buckets come back shortest class first."""
+    by_len: Dict[int, List[Tuple[int, np.ndarray]]] = {}
+    for row, seq in enumerate(seqs):
+        seq = np.asarray(seq, dtype=np.int32)
+        if max_len is not None and len(seq) > max_len:
+            seq = seq[-int(max_len):]
+        if not len(seq):
+            continue
+        by_len.setdefault(length_bucket(len(seq)), []).append((row, seq))
+    buckets: List[SequenceBucket] = []
+    for L in sorted(by_len):
+        members = by_len[L]
+        B = len(members)
+        ids = np.zeros((B, L), dtype=np.int32)
+        mask = np.zeros((B, L), dtype=np.float32)
+        rows = np.empty(B, dtype=np.int64)
+        for i, (row, seq) in enumerate(members):
+            rows[i] = row
+            ids[i, :len(seq)] = seq
+            mask[i, :len(seq)] = 1.0
+        buckets.append(SequenceBucket(rows, ids, mask))
+    return buckets
+
+
+# ---------------------------------------------------------------------------
+# Parameters / forward pass
+# ---------------------------------------------------------------------------
+
+def init_theta(n_items: int, params: SeqRecParams) -> Dict[str, np.ndarray]:
+    """Initialize the encoder parameter pytree (host numpy — pickles
+    into the Models repo like any P2L model; device copies are made per
+    call and cached by jit)."""
+    import jax
+
+    D = int(params.rank)
+    if D % int(params.n_heads):
+        raise ValueError(
+            f"rank {D} not divisible by n_heads {params.n_heads}")
+    F = D * int(params.ffn_mult)
+    L = length_bucket(int(params.max_seq_len))
+    key = jax.random.PRNGKey(int(params.seed))
+    ks = jax.random.split(key, 2 + 8 * int(params.n_layers))
+    theta: Dict[str, np.ndarray] = {
+        "item_emb": np.asarray(
+            jax.random.normal(ks[0], (n_items, D)) / math.sqrt(D),
+            dtype=np.float32),
+        "pos_emb": np.asarray(
+            jax.random.normal(ks[1], (L, D)) * 0.01, dtype=np.float32),
+        "ln_f_g": np.ones(D, dtype=np.float32),
+        "ln_f_b": np.zeros(D, dtype=np.float32),
+    }
+    kx = 2
+    for i in range(int(params.n_layers)):
+        for name, shape in (("wq", (D, D)), ("wk", (D, D)),
+                            ("wv", (D, D)), ("wo", (D, D)),
+                            ("w1", (D, F)), ("w2", (F, D))):
+            theta[f"l{i}_{name}"] = np.asarray(
+                jax.random.normal(ks[kx], shape) / math.sqrt(shape[0]),
+                dtype=np.float32)
+            kx += 1
+        theta[f"l{i}_b1"] = np.zeros(F, dtype=np.float32)
+        theta[f"l{i}_b2"] = np.zeros(D, dtype=np.float32)
+        for ln in ("ln1", "ln2"):
+            theta[f"l{i}_{ln}_g"] = np.ones(D, dtype=np.float32)
+            theta[f"l{i}_{ln}_b"] = np.zeros(D, dtype=np.float32)
+    return theta
+
+
+def _layer_norm(x, g, b, eps: float = 1e-6):
+    import jax.numpy as jnp
+
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * g + b
+
+
+def _heads_split(x, n_heads: int):
+    # [B, L, D] -> [B, H, L, D/H]
+    B, L, D = x.shape
+    return x.reshape(B, L, n_heads, D // n_heads).transpose(0, 2, 1, 3)
+
+
+def _heads_join(x):
+    # [B, H, L, Dh] -> [B, L, D]
+    B, H, L, Dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B, L, H * Dh)
+
+
+def _dense_attention(q, k, v, mask):
+    from predictionio_tpu.ops.attention import mha_reference
+
+    return mha_reference(q, k, v, causal=True, key_padding_mask=mask)
+
+
+def encoder_forward(theta, ids, mask, *, n_layers: int, n_heads: int,
+                    attention_fn=None):
+    """The SASRec encoder: ``[B, L]`` item ids + mask -> ``[B, L, D]``
+    hidden states (pad positions exactly zero).
+
+    Pre-LN blocks: ``x += Wo·MHA(LN(x))`` then ``x += FFN(LN(x))``,
+    final LN; causal + key-padding masking inside the attention.
+    ``attention_fn(q, k, v, mask)`` defaults to the dense
+    :func:`mha_reference` oracle; the mesh lane passes the
+    sequence-parallel kernels instead."""
+    import jax.numpy as jnp
+
+    if attention_fn is None:
+        attention_fn = _dense_attention
+    L = ids.shape[1]
+    D = theta["item_emb"].shape[1]
+    keep = mask[..., None]
+    x = jnp.take(theta["item_emb"], ids, axis=0) * math.sqrt(D)
+    x = (x + theta["pos_emb"][:L]) * keep
+    for i in range(n_layers):
+        h = _layer_norm(x, theta[f"l{i}_ln1_g"], theta[f"l{i}_ln1_b"])
+        q = _heads_split(h @ theta[f"l{i}_wq"], n_heads)
+        k = _heads_split(h @ theta[f"l{i}_wk"], n_heads)
+        v = _heads_split(h @ theta[f"l{i}_wv"], n_heads)
+        a = _heads_join(attention_fn(q, k, v, mask))
+        x = x + (a @ theta[f"l{i}_wo"]) * keep
+        h2 = _layer_norm(x, theta[f"l{i}_ln2_g"], theta[f"l{i}_ln2_b"])
+        f = jnp.maximum(h2 @ theta[f"l{i}_w1"] + theta[f"l{i}_b1"], 0.0)
+        x = x + (f @ theta[f"l{i}_w2"] + theta[f"l{i}_b2"]) * keep
+    x = _layer_norm(x, theta["ln_f_g"], theta["ln_f_b"])
+    return x * keep
+
+
+def _last_hidden(h, mask):
+    """Hidden state at each row's LAST real position -> ``[B, D]`` user
+    vectors (all-pad rows come back zero)."""
+    import jax.numpy as jnp
+
+    lens = jnp.sum(mask, axis=1).astype(jnp.int32)
+    last = jnp.maximum(lens - 1, 0)
+    vec = jnp.take_along_axis(
+        h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0, :]
+    return vec * (lens > 0)[:, None]
+
+
+@functools.lru_cache(maxsize=16)
+def _encode_jit(n_layers: int, n_heads: int):
+    import jax
+
+    @jax.jit
+    def run(theta, ids, mask):
+        h = encoder_forward(theta, ids, mask, n_layers=n_layers,
+                            n_heads=n_heads)
+        return _last_hidden(h, mask)
+
+    return run
+
+
+def encode_bucket(theta, bucket: SequenceBucket,
+                  params: SeqRecParams) -> np.ndarray:
+    """One bucket's user vectors ``[B, D]`` (single-device jitted
+    program, cached per (layers, heads) x shape)."""
+    out = _encode_jit(int(params.n_layers), int(params.n_heads))(
+        theta, bucket.ids, bucket.mask)
+    return np.asarray(out, dtype=np.float32)
+
+
+def encode_users(theta, buckets: Sequence[SequenceBucket], n_users: int,
+                 params: SeqRecParams, mesh=None) -> np.ndarray:
+    """All users' vectors ``[n_users, D]`` — rows in no bucket (users
+    with no events) stay zero. With a mesh the per-layer attention runs
+    the sequence-parallel kernels (:func:`encode_bucket_mesh`)."""
+    D = int(params.rank)
+    out = np.zeros((n_users, D), dtype=np.float32)
+    for bucket in buckets:
+        if mesh is not None and params.sp_mode != "off":
+            vecs = encode_bucket_mesh(theta, bucket, params, mesh)
+        else:
+            vecs = encode_bucket(theta, bucket, params)
+        out[bucket.rows] = vecs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Mesh lane: the sequence-parallel kernels, finally in anger
+# ---------------------------------------------------------------------------
+
+def select_sp_kernel(mesh, axis_name: str, n_heads: int, seq_len: int,
+                     sp_mode: str = "auto") -> Optional[str]:
+    """Which sequence-parallel kernel a (mesh, shape) pair can run:
+    ``ulysses`` when both heads and length divide the axis, else
+    ``ring`` when the length divides, else ``None`` (dense fallback —
+    e.g. an 8-long bucket on an 8-way mesh leaves no tokens to shard).
+    An explicit ``sp_mode`` forces its lane and raises when the shape
+    cannot support it."""
+    size = mesh.shape[axis_name]
+    if sp_mode == "off":
+        return None
+    ring_ok = seq_len % size == 0 and seq_len >= 2 * size
+    uly_ok = ring_ok and n_heads % size == 0
+    if sp_mode == "ulysses":
+        if not uly_ok:
+            raise ValueError(
+                f"sp_mode=ulysses needs heads ({n_heads}) and length "
+                f"({seq_len}) divisible by the {size}-way mesh axis")
+        return "ulysses"
+    if sp_mode == "ring":
+        if not ring_ok:
+            raise ValueError(
+                f"sp_mode=ring needs length ({seq_len}) divisible by "
+                f"the {size}-way mesh axis")
+        return "ring"
+    if uly_ok:
+        return "ulysses"
+    if ring_ok:
+        return "ring"
+    return None
+
+
+def encode_bucket_mesh(theta, bucket: SequenceBucket,
+                       params: SeqRecParams, mesh,
+                       axis_name: str = "data") -> np.ndarray:
+    """Encode one bucket with the per-layer attention running
+    SEQUENCE-PARALLEL over the mesh (ring or Ulysses — the kernels'
+    first real workload). The non-attention math runs replicated jnp
+    ops; the attention programs are the cached shard_map jits from
+    ``ops/attention.py``. Falls back to the single-device program when
+    the bucket's length class cannot shard over the axis."""
+    from predictionio_tpu.ops.attention import (
+        ring_attention,
+        ulysses_attention,
+    )
+
+    kernel = select_sp_kernel(mesh, axis_name, int(params.n_heads),
+                              bucket.seq_len, params.sp_mode)
+    if kernel is None:
+        return encode_bucket(theta, bucket, params)
+    sp = ring_attention if kernel == "ring" else ulysses_attention
+
+    def attention_fn(q, k, v, mask):
+        return sp(q, k, v, mesh, axis_name=axis_name, causal=True,
+                  key_padding_mask=mask)
+
+    import jax.numpy as jnp
+
+    theta_d = {k: jnp.asarray(v) for k, v in theta.items()}
+    h = encoder_forward(theta_d, jnp.asarray(bucket.ids),
+                        jnp.asarray(bucket.mask),
+                        n_layers=int(params.n_layers),
+                        n_heads=int(params.n_heads),
+                        attention_fn=attention_fn)
+    return np.asarray(_last_hidden(h, jnp.asarray(bucket.mask)),
+                      dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Training: lax.scan over Adam steps, sampled softmax over the vocab
+# ---------------------------------------------------------------------------
+
+def _sampled_softmax_loss(theta, ids, mask, negs, *, n_layers: int,
+                          n_heads: int, l2: float):
+    """Next-item sampled softmax: position t's hidden state scores the
+    TRUE next item ``ids[t+1]`` against ``negs`` shared negatives; the
+    full [B, L, M] logits never materialize."""
+    import jax
+    import jax.numpy as jnp
+
+    h = encoder_forward(theta, ids, mask, n_layers=n_layers,
+                        n_heads=n_heads)
+    ctx = h[:, :-1, :]                            # [B, L-1, D]
+    pos_ids = ids[:, 1:]                          # [B, L-1]
+    valid = mask[:, :-1] * mask[:, 1:]            # [B, L-1]
+    E = theta["item_emb"]
+    pos_e = jnp.take(E, pos_ids, axis=0)          # [B, L-1, D]
+    pos_logit = jnp.sum(ctx * pos_e, axis=-1)     # [B, L-1]
+    neg_e = jnp.take(E, negs, axis=0)             # [Nn, D]
+    neg_logit = jnp.einsum("bld,nd->bln", ctx, neg_e)
+    logits = jnp.concatenate([pos_logit[..., None], neg_logit], axis=-1)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    nll = (lse - pos_logit) * valid
+    loss = jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1.0)
+    if l2:
+        loss = loss + l2 * jnp.sum(jnp.square(E)) / E.shape[0]
+    return loss
+
+
+@functools.lru_cache(maxsize=16)
+def _train_bucket_jit(n_layers: int, n_heads: int, steps: int, bs: int,
+                      n_negs: int, n_items: int, lr: float, l2: float):
+    """One compiled training program per (static-config, bucket-shape)
+    pair: ``lax.scan`` over ``steps`` Adam updates, each sampling a
+    minibatch of rows and a fresh negative set from the scan key."""
+    import jax
+    import jax.numpy as jnp
+
+    grad_fn = jax.value_and_grad(functools.partial(
+        _sampled_softmax_loss, n_layers=n_layers, n_heads=n_heads,
+        l2=l2))
+    b1, b2, eps = 0.9, 0.999, 1e-8
+
+    @jax.jit
+    def run(theta, ids, mask, key):
+        m0 = jax.tree_util.tree_map(jnp.zeros_like, theta)
+        v0 = jax.tree_util.tree_map(jnp.zeros_like, theta)
+
+        def step(carry, key):
+            theta, m, v, t = carry
+            k_rows, k_negs = jax.random.split(key)
+            sel = jax.random.randint(k_rows, (bs,), 0, ids.shape[0])
+            negs = jax.random.randint(k_negs, (n_negs,), 0, n_items)
+            loss, g = grad_fn(theta, jnp.take(ids, sel, axis=0),
+                              jnp.take(mask, sel, axis=0), negs)
+            t = t + 1
+            m = jax.tree_util.tree_map(
+                lambda mi, gi: b1 * mi + (1 - b1) * gi, m, g)
+            v = jax.tree_util.tree_map(
+                lambda vi, gi: b2 * vi + (1 - b2) * gi * gi, v, g)
+            scale = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+            theta = jax.tree_util.tree_map(
+                lambda ti, mi, vi: ti - scale * mi / (jnp.sqrt(vi) + eps),
+                theta, m, v)
+            return (theta, m, v, t), loss
+
+        keys = jax.random.split(key, steps)
+        (theta, _, _, _), losses = jax.lax.scan(
+            step, (theta, m0, v0, jnp.zeros((), jnp.float32)), keys)
+        return theta, losses
+
+    return run
+
+
+def plan_steps(buckets: Sequence[SequenceBucket],
+               params: SeqRecParams) -> List[Tuple[int, int]]:
+    """Per-bucket ``(steps, batch_size)`` the trainer will run:
+    ``num_steps`` split proportionally to bucket row counts (min 1
+    each), batch clipped to the bucket. One definition shared by
+    :func:`train_seqrec` and the bench's tokens/s accounting."""
+    total_rows = sum(len(b) for b in buckets)
+    if not total_rows:
+        raise ValueError("plan_steps: no non-empty sequences to train "
+                         "on (every user history was empty)")
+    return [(max(1, round(int(params.num_steps)
+                          * len(b) / total_rows)),
+             min(int(params.batch_size), len(b)))
+            for b in buckets]
+
+
+def train_seqrec(buckets: Sequence[SequenceBucket], n_items: int,
+                 params: SeqRecParams,
+                 theta: Optional[Dict[str, Any]] = None
+                 ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """Train the encoder over bucketed sequences.
+
+    ``num_steps`` total Adam steps are split across buckets
+    proportionally to their row counts (every non-empty bucket gets at
+    least one), each bucket running ONE jitted scan program — the
+    power-of-two length classes mean a ragged catalog compiles a
+    handful of programs. Returns ``(theta, per-step losses)`` with the
+    loss trace concatenated in execution order (the loss-decrease gate
+    in bench_quality reads it)."""
+    import jax
+
+    if not buckets:
+        raise ValueError("train_seqrec: no non-empty sequences to train "
+                         "on (every user history was empty)")
+    if theta is None:
+        theta = init_theta(n_items, params)
+    key = jax.random.PRNGKey(int(params.seed) + 1)
+    all_losses: List[np.ndarray] = []
+    for bucket, (steps, bs) in zip(buckets, plan_steps(buckets, params)):
+        run = _train_bucket_jit(
+            int(params.n_layers), int(params.n_heads), int(steps),
+            int(bs), int(params.n_negatives), int(n_items),
+            float(params.learning_rate), float(params.l2))
+        key, sub = jax.random.split(key)
+        theta, losses = run(theta, bucket.ids, bucket.mask, sub)
+        all_losses.append(np.asarray(losses, dtype=np.float32))
+    theta_np = {k: np.asarray(v, dtype=np.float32)
+                for k, v in theta.items()}
+    return theta_np, np.concatenate(all_losses)
+
+
+__all__ = [
+    "SeqRecParams",
+    "SequenceBucket",
+    "length_bucket",
+    "bucket_sequences",
+    "init_theta",
+    "encoder_forward",
+    "encode_bucket",
+    "encode_bucket_mesh",
+    "encode_users",
+    "select_sp_kernel",
+    "plan_steps",
+    "train_seqrec",
+]

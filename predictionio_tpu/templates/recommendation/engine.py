@@ -275,6 +275,8 @@ class EventDataSource(PDataSource):
         # serial builder even under pipelined_ingest: leave-last-out
         # splits on raw triple ORDER, which the pipelined finalize
         # does not preserve (merged (row, col) order)
+        from predictionio_tpu.data.sliding import leave_last_out
+
         td = self._read_training(pipelined=False)
         if isinstance(td, IndexedTrainingData):
             # eval works on typed ratings; decode the streamed triples
@@ -284,15 +286,9 @@ class EventDataSource(PDataSource):
         by_user: Dict[str, List[Rating]] = {}
         for r in td.ratings:
             by_user.setdefault(r.user, []).append(r)
-        train: List[Rating] = []
-        qa: List[Tuple[Query, Any]] = []
-        for user, rs in by_user.items():
-            if len(rs) < 2:
-                train.extend(rs)
-                continue
-            held = rs[-1]
-            train.extend(rs[:-1])
-            qa.append((Query(user=user, num=10), ActualResult([held.item])))
+        train, holdouts = leave_last_out(by_user)
+        qa = [(Query(user=user, num=10), ActualResult([held.item]))
+              for user, held in holdouts]
         return [(TrainingData(train), EmptyEvalInfo(), qa)]
 
     def _sliding_eval(self, p: DataSourceParams):
@@ -311,6 +307,8 @@ class EventDataSource(PDataSource):
                 "sliding-window eval materializes the scanned window and "
                 "is incompatible with streaming_block_size; drop one of "
                 "the two (the scan is bounded to the eval horizon)")
+        from predictionio_tpu.data.sliding import sliding_window_masks
+
         first_until = _parse_time(p.eval_first_until)
         t0 = first_until.timestamp()
         dur = float(p.eval_duration_days) * 86400.0
@@ -328,15 +326,9 @@ class EventDataSource(PDataSource):
         del probe
         times = batch.event_times
         sets = []
-        for k in range(int(p.eval_count)):
-            cut = t0 + k * dur
-            train_mask = times < cut
-            if not train_mask.any():
-                raise ValueError(
-                    f"sliding-eval window {k} has no training events "
-                    f"before {p.eval_first_until} + {k} windows — move "
-                    "eval_first_until later or reduce eval_count")
-            test_mask = (times >= cut) & (times < cut + dur)
+        for k, train_mask, test_mask in sliding_window_masks(
+                times, t0, dur, int(p.eval_count),
+                hint="move eval_first_until later or reduce eval_count"):
             td = _training_data_prechecked(
                 batch.entity_ids[train_mask],
                 batch.target_ids[train_mask],
@@ -493,6 +485,7 @@ class _DeviceServedModel:
         # derived caches rebuild on demand; keep model blobs lean
         state.pop("_cat_index", None)
         state.pop("_cat_black_cache", None)
+        state.pop("_theta_device", None)  # sequentialrec device cache
         return state
 
 
@@ -538,13 +531,22 @@ def _coerce_query(query: Any) -> Query:
 
 
 def _winners_to_result(idx, scores, black, num: int,
-                       item_map: StringIndexBiMap) -> PredictedResult:
+                       item_map: StringIndexBiMap,
+                       positive_only: bool = True) -> PredictedResult:
     """Fetched top-k row -> PredictedResult: drop blacklisted, non-finite
-    and non-positive scores host-side, clip to num. ``math.isfinite`` on
-    the python floats, not ``np.isfinite`` per element — this runs once
-    per query of a bulk batch-predict job."""
+    and (for ALS-style scorers) non-positive scores host-side, clip to
+    num. ``math.isfinite`` on the python floats, not ``np.isfinite`` per
+    element — this runs once per query of a bulk batch-predict job.
+
+    ``positive_only=False`` keeps negative finite scores: transformer
+    logits (the sequentialrec template) are only RELATIVELY calibrated,
+    so a user whose unseen-item dot products are all negative still has
+    a valid ranking — only the ``-inf`` device masks (padding / seen
+    items) must drop. Models opt out via ``serve_positive_scores_only
+    = False``; implicit-ALS keeps the historical positive filter."""
     keep = [(i, s) for i, s in zip(idx.tolist(), scores.tolist())
-            if i not in black and math.isfinite(s) and s > 0][:num]
+            if i not in black and math.isfinite(s)
+            and (s > 0 or not positive_only)][:num]
     if not keep:
         return PredictedResult(())
     items = item_map.decode(np.asarray([i for i, _ in keep],
@@ -624,7 +626,9 @@ def _serve_topk(server, model, query: Query) -> PredictedResult:
         idx, scores = server.user_topk(uidx, k)
     else:
         return PredictedResult(())
-    return _winners_to_result(idx, scores, black, query.num, item_map)
+    return _winners_to_result(
+        idx, scores, black, query.num, item_map,
+        positive_only=getattr(model, "serve_positive_scores_only", True))
 
 
 class _DeviceServingAlgo:
@@ -667,9 +671,11 @@ class _DeviceServingAlgo:
         for k, rows in groups.items():
             uids = np.asarray([r[1] for r in rows], dtype=np.int64)
             idx, scores = server.users_topk(uids, k)
+            positive = getattr(model, "serve_positive_scores_only", True)
             for row, (qx, _, black, num) in enumerate(rows):
                 results[qx] = _winners_to_result(
-                    idx[row], scores[row], black, num, model.item_map)
+                    idx[row], scores[row], black, num, model.item_map,
+                    positive_only=positive)
         return [(qx, results[qx]) for qx, _ in queries]
 
 

@@ -165,6 +165,28 @@ BUILTIN_TEMPLATES: Dict[str, Dict] = {
             }],
         },
     },
+    "sequentialrec": {
+        "description": "SASRec-style next-item prediction over "
+                       "per-user event sequences (net-new; causal "
+                       "transformer on the ring/Ulysses attention "
+                       "kernels, served via the device top-k store)",
+        "engineFactory":
+            "predictionio_tpu.templates.sequentialrec:engine_factory",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.sequentialrec"
+                ":engine_factory",
+            "datasource": {"params": {"appName": "INVALID_APP_NAME"}},
+            "preparator": {"params": {"maxSeqLen": 32}},
+            "algorithms": [{
+                "name": "seqrec",
+                "params": {"rank": 32, "nLayers": 2, "nHeads": 2,
+                           "numSteps": 300, "seed": 7},
+            }],
+        },
+    },
     "textclassification": {
         "description": "Text -> label: hashed embedding table + LR "
                        "trained on device, NB over token counts "

@@ -158,3 +158,128 @@ class TestUlyssesAttention:
         q = jnp.zeros((1, 8, 30, 8))
         with pytest.raises(ValueError, match="sequence length"):
             ulysses_attention(q, q, q, mesh)
+
+
+# ---------------------------------------------------------------------------
+# Key-padding masks (ragged user histories batched into padded tables)
+# ---------------------------------------------------------------------------
+
+def _dense_mask_oracle(q, k, v, kp, causal):
+    """Explicit dense-mask oracle: materialize the full [B, H, Lq, Lk]
+    additive mask and run a safe softmax in numpy — the independent
+    reference all three mask implementations are gated against."""
+    q, k, v = (np.asarray(x, dtype=np.float64) for x in (q, k, v))
+    kp = np.asarray(kp, dtype=bool)
+    scale = q.shape[-1] ** -0.5
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        s = np.where(np.arange(lq)[:, None] >= np.arange(lk)[None, :],
+                     s, -np.inf)
+    s = np.where(kp[:, None, None, :], s, -np.inf)
+    m = s.max(axis=-1, keepdims=True)
+    p = np.where(np.isneginf(m), 0.0, np.exp(s - m))
+    denom = p.sum(axis=-1, keepdims=True)
+    p = p / np.where(denom == 0.0, 1.0, denom)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _ragged_mask(b, l, seed=0):
+    """Per-row lengths in [1, l]; row 0 fully real, row b-1 length 1."""
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(1, l + 1, size=b)
+    lens[0] = l
+    lens[-1] = 1
+    return (np.arange(l)[None, :] < lens[:, None]).astype(np.float32)
+
+
+class TestKeyPaddingMask:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_mha_matches_dense_oracle(self, causal):
+        q, k, v = _qkv(b=3, l=16, seed=11)
+        kp = _ragged_mask(3, 16, seed=2)
+        got = np.asarray(mha_reference(q, k, v, causal=causal,
+                                       key_padding_mask=kp))
+        want = _dense_mask_oracle(q, k, v, kp, causal)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+    def test_bool_mask_accepted(self):
+        q, k, v = _qkv(b=2, l=8, seed=3)
+        kp = _ragged_mask(2, 8, seed=4)
+        a = np.asarray(mha_reference(q, k, v, key_padding_mask=kp))
+        b = np.asarray(mha_reference(q, k, v,
+                                     key_padding_mask=kp.astype(bool)))
+        np.testing.assert_array_equal(a, b)
+
+    def test_fully_masked_query_rows_output_zero(self):
+        """A query row whose visible keys are ALL masked outputs exact
+        zeros, not NaN — ragged batches always contain such rows."""
+        q, k, v = _qkv(b=2, l=8, seed=5)
+        out = np.asarray(mha_reference(
+            q, k, v, causal=True, key_padding_mask=np.zeros((2, 8))))
+        np.testing.assert_array_equal(out, np.zeros_like(out))
+        # partial mask: every row still finite (pad queries see only
+        # real keys causally before them, or nothing -> zeros)
+        kp = np.zeros((2, 8), dtype=np.float32)
+        kp[:, :3] = 1.0
+        out = np.asarray(mha_reference(q, k, v, causal=True,
+                                       key_padding_mask=kp))
+        assert np.isfinite(out).all()
+
+    def test_mask_of_ones_matches_maskless(self):
+        """An all-real mask must not perturb the historical path beyond
+        the safe-softmax formulation (same math, same result)."""
+        q, k, v = _qkv(b=2, l=12, seed=6)
+        kp = np.ones((2, 12), dtype=np.float32)
+        got = np.asarray(mha_reference(q, k, v, causal=True,
+                                       key_padding_mask=kp))
+        want = np.asarray(mha_reference(q, k, v, causal=True))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_matches_dense_oracle(self, causal):
+        from predictionio_tpu.ops.attention import ring_attention
+
+        q, k, v = _qkv(b=3, l=32, seed=7)
+        kp = _ragged_mask(3, 32, seed=8)
+        mesh = data_parallel_mesh(8)
+        got = np.asarray(ring_attention(q, k, v, mesh, causal=causal,
+                                        key_padding_mask=kp))
+        want = _dense_mask_oracle(q, k, v, kp, causal)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_ring_mask_on_smaller_ring(self):
+        from predictionio_tpu.ops.attention import ring_attention
+
+        q, k, v = _qkv(b=2, l=24, seed=9)
+        kp = _ragged_mask(2, 24, seed=10)
+        mesh = data_parallel_mesh(4)
+        got = np.asarray(ring_attention(q, k, v, mesh, causal=True,
+                                        key_padding_mask=kp))
+        want = _dense_mask_oracle(q, k, v, kp, True)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ulysses_matches_dense_oracle(self, causal):
+        from predictionio_tpu.ops.attention import ulysses_attention
+
+        rng = np.random.default_rng(12)
+        q, k, v = (jnp.asarray(rng.normal(size=(2, 8, 32, 16)),
+                               dtype=jnp.float32) for _ in range(3))
+        kp = _ragged_mask(2, 32, seed=13)
+        mesh = data_parallel_mesh(8)
+        got = np.asarray(ulysses_attention(q, k, v, mesh, causal=causal,
+                                           key_padding_mask=kp))
+        want = _dense_mask_oracle(q, k, v, kp, causal)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_masked_and_unmasked_programs_are_distinct(self):
+        """The unmasked lane keeps its historical three-operand program;
+        the masked lane caches separately."""
+        from predictionio_tpu.ops.attention import _ring_fn
+
+        mesh = data_parallel_mesh(4)
+        assert _ring_fn(mesh, "data", True, 0.25) \
+            is not _ring_fn(mesh, "data", True, 0.25, True)
+        assert _ring_fn(mesh, "data", True, 0.25, True) \
+            is _ring_fn(mesh, "data", True, 0.25, True)

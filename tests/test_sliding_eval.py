@@ -1,0 +1,146 @@
+"""Unit tests for the shared evaluation-split helpers
+(``data/sliding.py``) — the sliding-window / leave-last-out math both
+recommendation-family templates (recommendation + sequentialrec) decode
+into their own TrainingData shapes."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.sliding import (
+    group_by_entity,
+    leave_last_out,
+    sliding_window_masks,
+)
+
+
+class TestSlidingWindowMasks:
+    def test_window_boundary_event_lands_in_test_not_train(self):
+        """An event exactly AT a cut belongs to that cut's TEST window
+        (times >= cut) and to every LATER window's training set."""
+        times = np.array([0.0, 10.0, 20.0, 30.0])
+        wins = list(sliding_window_masks(times, t0=10.0, duration=10.0,
+                                         count=3))
+        assert len(wins) == 3
+        k0, train0, test0 = wins[0]
+        assert k0 == 0
+        # t=10.0 is exactly the first cut: test of window 0, not train
+        np.testing.assert_array_equal(train0, [True, False, False, False])
+        np.testing.assert_array_equal(test0, [False, True, False, False])
+        # window 1 (cut 20.0): t=10.0 now trains; t=20.0 tests
+        _, train1, test1 = wins[1]
+        np.testing.assert_array_equal(train1, [True, True, False, False])
+        np.testing.assert_array_equal(test1, [False, False, True, False])
+
+    def test_test_window_is_half_open(self):
+        """test = [cut, cut + duration): the event at cut+duration falls
+        in the NEXT window."""
+        times = np.array([0.0, 20.0])
+        _, _, test0 = next(iter(
+            sliding_window_masks(times, t0=10.0, duration=10.0, count=1)))
+        np.testing.assert_array_equal(test0, [False, False])
+
+    def test_empty_training_window_raises(self):
+        times = np.array([50.0, 60.0])
+        with pytest.raises(ValueError, match="no training events"):
+            list(sliding_window_masks(times, t0=10.0, duration=10.0,
+                                      count=2))
+
+    def test_later_empty_window_names_its_index(self):
+        times = np.array([5.0])
+        gen = sliding_window_masks(times, t0=10.0, duration=10.0, count=2)
+        k0, train0, _ = next(gen)
+        assert k0 == 0 and train0.all()
+        # window 1 trains on everything before 20.0 — still fine
+        k1, train1, _ = next(gen)
+        assert k1 == 1 and train1.all()
+
+    def test_nonpositive_duration_raises(self):
+        with pytest.raises(ValueError, match="duration"):
+            list(sliding_window_masks(np.array([0.0]), 0.0, 0.0, 1))
+
+    def test_empty_test_window_is_allowed(self):
+        """A window whose TEST set is empty yields an all-false test
+        mask (no actuals to score) rather than raising — only empty
+        TRAINING is fatal."""
+        times = np.array([0.0, 1.0])
+        _, train, test = next(iter(
+            sliding_window_masks(times, t0=10.0, duration=10.0, count=1)))
+        assert train.all() and not test.any()
+
+
+class TestLeaveLastOut:
+    def test_basic_split(self):
+        groups = {"u1": ["a", "b", "c"], "u2": ["x", "y"]}
+        train, held = leave_last_out(groups)
+        assert train == ["a", "b", "x"]
+        assert held == [("u1", "c"), ("u2", "y")]
+
+    def test_single_event_group_goes_whole_to_train(self):
+        groups = {"solo": ["only"], "pair": ["p", "q"]}
+        train, held = leave_last_out(groups)
+        assert "only" in train
+        assert held == [("pair", "q")]
+
+    def test_empty_groups(self):
+        train, held = leave_last_out({})
+        assert train == [] and held == []
+
+    def test_group_order_preserved(self):
+        groups = {"b": [1, 2], "a": [3, 4]}
+        _, held = leave_last_out(groups)
+        assert [k for k, _ in held] == ["b", "a"]
+
+
+class TestGroupByEntity:
+    def test_groups_in_first_seen_order(self):
+        ents = ["u2", "u1", "u2", "u1"]
+        payloads = [10, 20, 30, 40]
+        groups = group_by_entity(ents, payloads)
+        assert list(groups) == ["u2", "u1"]
+        assert groups["u2"] == [10, 30]
+        assert groups["u1"] == [20, 40]
+
+    def test_composes_with_leave_last_out(self):
+        ents = np.asarray(["u1", "u1", "u2"], dtype=object)
+        items = ["i1", "i2", "i3"]
+        train, held = leave_last_out(group_by_entity(ents, items))
+        assert train == ["i1", "i3"]
+        assert held == [("u1", "i2")]
+
+
+class TestRecommendationTemplateUsesHelper:
+    """The template's read_eval routes through the shared helpers (the
+    refactor guard: same protocol, one definition)."""
+
+    def test_leave_last_out_protocol_unchanged(self, mem_storage):
+        import datetime as dt
+
+        from predictionio_tpu.controller import ComputeContext
+        from predictionio_tpu.data import storage
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.templates.recommendation import (
+            DataSourceParams,
+            EventDataSource,
+        )
+
+        aid = storage.get_metadata_apps().insert(App(0, "slideapp"))
+        le = storage.get_levents()
+        le.init(aid)
+        t0 = dt.datetime(2021, 1, 1, tzinfo=dt.timezone.utc)
+        events = []
+        for u, n in (("u1", 3), ("u2", 1)):
+            for j in range(n):
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=u,
+                    target_entity_type="item", target_entity_id=f"i{j}",
+                    properties={"rating": 4.0}, event_time=t0))
+        le.insert_batch(events, aid)
+        ds = EventDataSource(DataSourceParams(app_name="slideapp"))
+        sets = ds.read_eval(ComputeContext())
+        assert len(sets) == 1
+        td, _, qa = sets[0]
+        # u1 holds out its last item; u2 (single event) trains whole
+        assert len(td.ratings) == 3
+        assert [q.user for q, _ in qa] == ["u1"]
+        assert qa[0][1].items == ("i2",)

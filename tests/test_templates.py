@@ -877,3 +877,142 @@ class TestECommerceTemplate:
                                       categories=("laptops",)))
         assert all(s.item in {"i4", "i5", "i6", "i7"}
                    for s in r.item_scores)
+
+
+# ---------------------------------------------------------------------------
+# Cross-template engine smoke: train -> deploy -> query over HTTP for
+# every registered recommendation-shaped template on a tiny synthetic
+# stream. New templates join the parametrization — the registry and the
+# full serving plane are exercised per template, not just the flagship.
+# ---------------------------------------------------------------------------
+
+import http.client
+import json as _json
+
+
+def _smoke_post(addr, path, body):
+    host, port = addr
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("POST", path, body=_json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = _json.loads(resp.read().decode("utf-8"))
+    conn.close()
+    return resp.status, data
+
+
+def _seed_rating_stream(app_name):
+    aid = make_app(app_name)
+    le = storage.get_levents()
+    rng = np.random.default_rng(0)
+    events = []
+    for u in range(12):
+        for j in range(5):
+            events.append(ev(
+                "rate", "user", f"u{u}", "item",
+                f"i{int(rng.integers(0, 10))}",
+                props={"rating": float(rng.integers(3, 6))},
+                t=T0 + dt.timedelta(minutes=j)))
+    le.insert_batch(events, aid)
+
+
+def _seed_view_stream(app_name):
+    aid = make_app(app_name)
+    le = storage.get_levents()
+    rng = np.random.default_rng(0)
+    events = []
+    for u in range(12):
+        start = int(rng.integers(0, 10))
+        for j in range(5):
+            events.append(ev("view", "user", f"u{u}", "item",
+                             f"i{(start + j) % 10}",
+                             t=T0 + dt.timedelta(minutes=j)))
+    le.insert_batch(events, aid)
+
+
+def _recommendation_case():
+    from predictionio_tpu.ops.als import ALSParams
+    from predictionio_tpu.templates.recommendation import DataSourceParams
+
+    return (
+        "predictionio_tpu.templates.recommendation:engine_factory",
+        _seed_rating_stream,
+        EngineParams(
+            data_source_params=("", DataSourceParams(
+                app_name="smokeapp")),
+            algorithm_params_list=[
+                ("als", ALSParams(rank=4, num_iterations=2, seed=0))]),
+    )
+
+
+def _sequentialrec_case():
+    from predictionio_tpu.templates.sequentialrec import (
+        DataSourceParams,
+        SeqPreparatorParams,
+        SeqRecParams,
+    )
+
+    return (
+        "predictionio_tpu.templates.sequentialrec:engine_factory",
+        _seed_view_stream,
+        EngineParams(
+            data_source_params=("", DataSourceParams(
+                app_name="smokeapp")),
+            preparator_params=("", SeqPreparatorParams(max_seq_len=8)),
+            algorithm_params_list=[
+                ("seqrec", SeqRecParams(rank=8, n_layers=1, n_heads=2,
+                                        max_seq_len=8, num_steps=20,
+                                        batch_size=16, n_negatives=8,
+                                        seed=0))]),
+    )
+
+
+_ENGINE_SMOKE_CASES = {
+    "recommendation": _recommendation_case,
+    "sequentialrec": _sequentialrec_case,
+}
+
+
+class TestCrossTemplateEngineSmoke:
+    @pytest.mark.parametrize("template", sorted(_ENGINE_SMOKE_CASES))
+    def test_train_deploy_query(self, template, mem_storage):
+        from predictionio_tpu.tools.template_commands import (
+            BUILTIN_TEMPLATES,
+        )
+        from predictionio_tpu.workflow import (
+            QueryServer,
+            ServerConfig,
+            run_train,
+        )
+        from predictionio_tpu.workflow import core_workflow
+        from predictionio_tpu.workflow.create_workflow import (
+            WorkflowConfig,
+            new_engine_instance,
+        )
+
+        factory, seed, params = _ENGINE_SMOKE_CASES[template]()
+        # every smoke case is a REGISTERED template (pio template list)
+        assert template in BUILTIN_TEMPLATES
+        assert BUILTIN_TEMPLATES[template]["engineFactory"] == factory
+
+        seed("smokeapp")
+        engine = core_workflow.load_engine_factory(factory)()
+        config = WorkflowConfig(engine_factory=factory)
+        iid = run_train(engine, params,
+                        new_engine_instance(config, params), ctx=CTX)
+        assert iid is not None
+
+        srv = QueryServer(ServerConfig(ip="127.0.0.1", port=0)).start(
+            undeploy_stale=False)
+        try:
+            status, result = _smoke_post(srv.address, "/queries.json",
+                                         {"user": "u1", "num": 3})
+            assert status == 200
+            assert result["itemScores"]
+            scores = [s["score"] for s in result["itemScores"]]
+            assert scores == sorted(scores, reverse=True)
+            status, result = _smoke_post(srv.address, "/queries.json",
+                                         {"user": "nobody"})
+            assert status == 200 and result["itemScores"] == []
+        finally:
+            srv.stop()
