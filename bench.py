@@ -555,6 +555,238 @@ def scale_ingest_bench(n_users: int = 138_000, n_items: int = 27_000,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _synthetic_rating_blocks(n_users: int, n_items: int, nnz: int,
+                             seed: int, block_size: int):
+    """Dictionary-encoded ColumnarEvents blocks synthesized on the fly
+    — the 1B-rating lane cannot afford to write a ~100 GB JSONL store
+    first, and the pipelined ingest consumes the same block shape
+    ``find_columnar_blocks`` yields (power-law user/item draws like
+    ``_write_scale_store``)."""
+    from predictionio_tpu.data.columnar import ColumnarEvents
+
+    rng = np.random.default_rng(seed)
+    item_p = 1.0 / np.arange(1, n_items + 1) ** 0.8
+    item_p /= item_p.sum()
+    user_p = 1.0 / np.arange(1, n_users + 1) ** 0.6
+    user_p /= user_p.sum()
+    for off in range(0, nnz, block_size):
+        m = min(block_size, nnz - off)
+        rs = rng.choice(n_users, size=m, p=user_p)
+        cs = rng.choice(n_items, size=m, p=item_p)
+        vs = rng.integers(1, 6, size=m).astype(np.float32)
+        ulab, ucodes = np.unique(rs, return_inverse=True)
+        ilab, icodes = np.unique(cs, return_inverse=True)
+        yield ColumnarEvents(
+            entity_ids=None, target_ids=None, values=vs,
+            event_times=np.zeros(m, dtype=np.float64),
+            entity_codes=ucodes.astype(np.int32),
+            entity_labels=np.asarray([f"u{int(u)}" for u in ulab],
+                                     dtype=object),
+            target_codes=icodes.astype(np.int32),
+            target_labels=np.asarray([f"i{int(i)}" for i in ilab],
+                                     dtype=object))
+
+
+def scale_1b_bench(n_users: int = 2_000_000, n_items: int = 200_000,
+                   nnz: int = 1_000_000_000, rank: int = 64,
+                   iterations: int = 1, seed: int = 17,
+                   block_size: int = 4_000_000,
+                   topk_queries: int = 64) -> dict:
+    """The ALX-scale lane (ROADMAP item 2 / ISSUE 15): a 1B-rating
+    synthetic power-law stream through the PR-6 pipelined ingest onto a
+    multi-chip mesh — sharded bucketed training with the factors kept
+    in HBM, then the density-aware sharded serving store answers top-k
+    straight from the training shards (per-shard ``lax.top_k`` +
+    on-device log-tree merge, zero steady-state compiles asserted).
+
+    The artifact stamps the shard count and measuring device (a
+    1-device host clamps to 1 shard and says so), the layout's
+    interaction balance vs the contiguous-span baseline, and per-shard
+    HBM. ``PIO_BENCH_SCALE1B=0`` skips the full-shape run in ``main``;
+    smoke runs a CPU-sized shape end to end so bench day never
+    discovers a wiring error at rating one billion."""
+    import jax
+
+    from predictionio_tpu.data.columnar import ingest_ratings_pipelined
+    from predictionio_tpu.ops.als import (
+        ALSParams,
+        item_interaction_counts,
+    )
+    from predictionio_tpu.ops.serving import DeviceTopK
+    from predictionio_tpu.parallel.als_sharding import (
+        contiguous_item_layout,
+        density_aware_item_layout,
+        train_als_device,
+    )
+    from predictionio_tpu.utils import metrics
+    from predictionio_tpu.utils.tracing import StageTimeline
+
+    params = ALSParams(rank=rank, num_iterations=iterations, seed=1,
+                       bucket_slot_budget=4_000_000)
+    timeline = StageTimeline()
+    t0 = time.perf_counter()
+    res = ingest_ratings_pipelined(
+        _synthetic_rating_blocks(n_users, n_items, nnz, seed,
+                                 block_size),
+        stage_device=True, timeline=timeline)
+    res.wait(warmup=False)
+    ingest_sec = time.perf_counter() - t0
+    us_d, its_d = res.user_side, res.item_side
+    counts = item_interaction_counts(its_d)
+    summary = timeline.summary()
+    ingest_busy = sum(
+        v["busy_sec"] for k, v in summary["stages"].items()
+        if k not in ("warmup_compile", "warmup_wait", "h2d.wait"))
+
+    # -- sharded training: factors stay in HBM (PAlgorithm flavor) ----
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    X, Y = train_als_device(us_d, its_d, params)
+    first_sec = time.perf_counter() - t0
+    assert bool(jnp.isfinite(X).all()) and bool(jnp.isfinite(Y).all())
+    t0 = time.perf_counter()
+    X, Y = train_als_device(us_d, its_d, params)
+    steady_sec = time.perf_counter() - t0
+    epoch_sec = steady_sec / iterations
+
+    # -- density-aware sharded serving straight from the shards -------
+    n_dev = len(jax.devices())
+    layout = density_aware_item_layout(counts, n_dev)
+    store = DeviceTopK(X, Y, seen=None, n_users=us_d.n_rows,
+                       n_items=its_d.n_rows, item_layout=layout,
+                       microbatch=False)
+    metrics.install_jit_compile_listener()
+    store.warmup(max_k=16)
+    compiles0 = metrics.JIT_COMPILES.value()
+    lat = []
+    rng = np.random.default_rng(3)
+    uids = rng.integers(0, us_d.n_rows, size=(topk_queries, 8))
+    for q in range(topk_queries):
+        t0 = time.perf_counter()
+        store.users_topk(uids[q], 10)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    jit_delta = metrics.JIT_COMPILES.value() - compiles0
+    mem = store.memory_report()
+    result = _stamp_device({
+        "events": int(nnz),
+        "n_users": int(us_d.n_rows), "n_items": int(its_d.n_rows),
+        "rank": rank,
+        "shards": store.shard_count,
+        "devices": n_dev,
+        "ingest_sec": round(ingest_sec, 2),
+        "ingest_events_per_sec": round(nnz / ingest_sec, 1),
+        "ingest_overlap_ratio": round(ingest_busy / ingest_sec, 3)
+        if ingest_sec > 0 else None,
+        "unique_pairs": int(res.nnz),
+        "first_train_sec_incl_compile": round(first_sec, 1),
+        "epoch_sec": round(epoch_sec, 3),
+        "events_per_sec": round(int(us_d.nnz) / epoch_sec, 1),
+        "serving_topk_p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "serving_jit_compiles_steady_state": int(jit_delta),
+        "zero_compile_steady_state": jit_delta == 0,
+        "shard_balance": layout.balance_report(),
+        "contiguous_balance": contiguous_item_layout(
+            its_d.n_rows, n_dev, counts=counts).balance_report(),
+        "hbm_per_shard_bytes": [e["factorBytes"]
+                                for e in mem.get("shards", [])],
+        "store_total_bytes": mem["totalBytes"],
+        "note": ("synthetic 1B-lane: pipelined ingest from generated "
+                 "encoded blocks (no store write), sharded bucketed "
+                 "training kept in HBM, density-aware sharded top-k "
+                 "serving with on-device merge; shard count is what "
+                 "the host actually had — 1 on a single-device smoke"),
+    })
+    store.close()
+    return result
+
+
+def artifact_schema_problems(artifact: dict) -> list:
+    """Validate the bench artifact's staleness self-description (the
+    PR-11 contract, now a checkable schema): the headline must carry
+    ``accelerator`` and every dict-valued lane under ``detail`` must
+    carry its per-lane ``device`` stamp — new lanes included, so the
+    self-description can't silently regress. Returns problem strings
+    (empty = conformant)."""
+    problems = []
+    if "accelerator" not in artifact:
+        problems.append("headline missing 'accelerator'")
+    detail = artifact.get("detail")
+    if not isinstance(detail, dict):
+        problems.append("artifact missing 'detail' dict")
+        return problems
+    for name, lane in detail.items():
+        if isinstance(lane, dict) and "device" not in lane:
+            problems.append(f"lane {name!r} missing 'device' stamp")
+    return problems
+
+
+def device_audit(out_path: str = "DEVICE_AUDIT.json") -> dict:
+    """``bench.py --device-audit`` — the ROADMAP housekeeping note as
+    ONE command: run every lane that has never produced a device
+    number (serving_load, scale_ingest, foldin_freshness, bf16
+    training, int8+fused serving, the ISSUE-15 sharded lanes) plus
+    ``pytest -m pallas`` (the fused kernels through the REAL Mosaic
+    pipeline), and write a single staleness report so the next live
+    tunnel session is one command."""
+    import os
+    import subprocess
+    import sys
+
+    on_accel = device_platform() != "cpu"
+    lanes = {}
+
+    def run_lane(name, fn, **kw):
+        t0 = time.perf_counter()
+        try:
+            lanes[name] = _stamp_device(fn(**kw))
+        except Exception as e:  # one broken lane must not kill the audit
+            lanes[name] = {"error": f"{type(e).__name__}: {e}",
+                           "device": device_platform()}
+        lanes[name]["lane_wall_sec"] = round(
+            time.perf_counter() - t0, 1)
+
+    run_lane("serving_load", serving_load_bench)
+    run_lane("serving_load_sharded", serving_load_bench, serve_shards=4)
+    run_lane("scale_ingest_20m", scale_ingest_bench)
+    # the sharded-scale lane at a REDUCED shape: the audit's job is a
+    # device-stamped staleness sweep inside one session's budget — the
+    # full 1B headline stays `python bench.py`'s (PIO_BENCH_SCALE1B)
+    run_lane("scale_1b_reduced", scale_1b_bench, n_users=100_000,
+             n_items=20_000, nnz=10_000_000, iterations=1,
+             block_size=2_000_000)
+    run_lane("foldin_freshness", foldin_freshness_bench)
+    run_lane("bf16_training", als_precision_bench)
+    run_lane("int8_fused_serving", serving_quantized_lane_bench)
+
+    pallas = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", "-m", "pallas",
+         "-p", "no:cacheprovider"],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True)
+    report = {
+        "check": "device_audit",
+        "accelerator": on_accel,
+        "device": device_platform(),
+        "lanes": lanes,
+        "pallas_pytest": {
+            "returncode": pallas.returncode,
+            "tail": pallas.stdout.strip().splitlines()[-3:],
+        },
+        "note": ("one-command staleness audit: every never-benched-on-"
+                 "device lane + pytest -m pallas; accelerator=false "
+                 "means this audit itself ran on CPU and cleared "
+                 "nothing"),
+    }
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"metric": "device_audit", "accelerator": on_accel,
+                      "lanes": len(lanes),
+                      "pallas_rc": pallas.returncode,
+                      "artifact": out_path}))
+    return report
+
+
 def text_classification_bench(n_per_class: int = 400, seed: int = 3) -> dict:
     """Quality number for the net-new text-classification template
     (BASELINE.json configs[4]): device-trained hashed-embedding + LR vs
@@ -881,6 +1113,7 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
                        seed: int = 23,
                        serve_precision: Optional[str] = None,
                        serve_kernel: Optional[str] = None,
+                       serve_shards: Optional[int] = None,
                        template: str = "recommendation") -> dict:
     """Closed-loop HTTP load generator against a DEPLOYED query server
     — the PR-10 continuous-batching acceptance bench (ROADMAP item 2:
@@ -909,7 +1142,11 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
     the historical lane) or ``sequentialrec`` (the SASRec next-item
     template — its user-vector store serves through the SAME DeviceTopK
     path, so the sweep proves the whole continuous-batching plane for
-    the sequence-model family too)."""
+    the sequence-model family too). ``serve_shards`` runs the ISSUE-15
+    sharded lane: the deployed store density-shards over that many
+    devices (clamped to what the host has — the artifact stamps the
+    REAL shard count) and every query runs per-shard top-k + on-device
+    merge, zero-compile gate unchanged."""
     import datetime as _dt
     import http.client
     import os
@@ -941,6 +1178,7 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
     prior_backend = os.environ.get("PIO_SERVING_BACKEND")
     prior_precision = os.environ.get("PIO_SERVE_PRECISION")
     prior_kernel = os.environ.get("PIO_SERVE_KERNEL")
+    prior_shards = os.environ.get("PIO_SERVE_SHARDS")
     # the point is the continuous-batching DEVICE path; auto would pick
     # HostTopK for a model this small on CPU
     os.environ["PIO_SERVING_BACKEND"] = "device"
@@ -950,6 +1188,8 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
         os.environ["PIO_SERVE_PRECISION"] = serve_precision
     if serve_kernel is not None:
         os.environ["PIO_SERVE_KERNEL"] = serve_kernel
+    if serve_shards is not None:
+        os.environ["PIO_SERVE_SHARDS"] = str(int(serve_shards))
     srv = None
     try:
         storage_mod.reset(StorageConfig(
@@ -1132,12 +1372,20 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
         flight = device_telemetry.recorder().summary()
         dev_report = serving_mod.device_report()
 
+        # the REAL shard counts the deployed stores ended up with
+        # (PIO_SERVE_SHARDS clamps to available devices)
+        shard_counts = sorted({
+            s["store"].get("nShards", 1) for s in dev_report["stores"]
+        }) or [1]
+
         return _stamp_device({
             "template": template,
             "clients": clients,
             "duration_sec_per_level": duration_sec,
             "serve_precision": serve_precision or "default",
             "serve_kernel": serve_kernel or "auto",
+            "serve_shards_requested": serve_shards,
+            "serve_shards": shard_counts[-1],
             "deploy_warmup_sec": round(deploy_sec, 2),
             "levels": sweep,
             "max_sustainable_qps": None if sustainable is None
@@ -1175,7 +1423,8 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
             srv.stop()
         for var, prior in (("PIO_SERVING_BACKEND", prior_backend),
                            ("PIO_SERVE_PRECISION", prior_precision),
-                           ("PIO_SERVE_KERNEL", prior_kernel)):
+                           ("PIO_SERVE_KERNEL", prior_kernel),
+                           ("PIO_SERVE_SHARDS", prior_shards)):
             if prior is None:
                 os.environ.pop(var, None)
             else:
@@ -2164,6 +2413,17 @@ def main(smoke: bool = False) -> None:
     discovers a wiring error on the real device."""
     import os
 
+    if smoke and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # the smoke flow exercises the ISSUE-15 sharded lanes for real:
+        # 4 virtual host-platform devices (must land before the first
+        # jax import — nothing above here imports jax). The flag only
+        # affects the host platform, so a live accelerator still wins
+        # backend selection with its own device count.
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_"
+                                     "device_count=4").strip()
+
     if smoke and os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         # a sitecustomize (axon tunnel) may pin the real accelerator
         # after env setup; the smoke run honors the caller's cpu ask
@@ -2237,6 +2497,18 @@ def main(smoke: bool = False) -> None:
             "PIO_BENCH_SCALE100", "1").strip() != "0":
         scale100 = scale_ingest_bench(nnz=100_000_000, iterations=1)
 
+    # the 1B-rating ALX-scale lane (ISSUE 15): pipelined synthetic
+    # stream -> sharded training in HBM -> density-aware sharded
+    # serving with the zero-compile gate. PIO_BENCH_SCALE1B=0 skips
+    # the full shape; smoke always runs the CPU-sized wiring check.
+    scale1b = None
+    if smoke:
+        scale1b = scale_1b_bench(n_users=1500, n_items=400,
+                                 nnz=120_000, rank=16, iterations=2,
+                                 block_size=30_000, topk_queries=16)
+    elif os.environ.get("PIO_BENCH_SCALE1B", "1").strip() != "0":
+        scale1b = scale_1b_bench()
+
     # quality parity (the second BASELINE target): Precision@10 of the
     # device ALS vs the CPU reference on the same holdout split, plus
     # the truncation-cost check at the ML-1M shape
@@ -2282,6 +2554,15 @@ def main(smoke: bool = False) -> None:
     # item 4 acceptance: >=2x QPS + ~4x catalog per chip on device;
     # CPU smoke proves the wiring and the zero-compile gate only)
     serving_quant = serving_quantized_lane_bench(
+        **({"n_users": 96, "n_items": 64, "levels": (50.0, 100.0),
+            "duration_sec": 1.0, "clients": 4} if smoke else {}))
+
+    # the ISSUE-15 sharded serving lane: same closed-loop sweep with
+    # the deployed store density-sharded over the mesh (per-shard
+    # top-k + on-device merge; zero-compile gate still asserted). The
+    # artifact stamps the REAL shard count the host could provide.
+    serving_load_sharded = serving_load_bench(
+        serve_shards=4,
         **({"n_users": 96, "n_items": 64, "levels": (50.0, 100.0),
             "duration_sec": 1.0, "clients": 4} if smoke else {}))
 
@@ -2355,6 +2636,7 @@ def main(smoke: bool = False) -> None:
         },
         "scale_20m": scale20,
         "scale_100m": scale100,
+        "scale_1b": scale1b,
         "train_resume": train_resume,
         "precision_lanes": precision,
         "quality": quality,
@@ -2362,6 +2644,7 @@ def main(smoke: bool = False) -> None:
         "text_classification": text_quality,
         "serving": serving,
         "serving_load": serving_load,
+        "serving_load_sharded": serving_load_sharded,
         "seqrec_train": seqrec_train,
         "serving_load_sequentialrec": serving_load_seqrec,
         "seqrec_quality": seqrec_quality,
@@ -2376,7 +2659,14 @@ def main(smoke: bool = False) -> None:
     # every lane carries the backend it measured on
     for section in detail.values():
         _stamp_device(section)
-    print(json.dumps({**headline, "detail": detail}))
+    artifact = {**headline, "detail": detail}
+    # the staleness self-description is a checked contract now: a lane
+    # that forgot its stamp fails the bench run, not a future reviewer.
+    # Checked AFTER printing (below) so the violation never costs the
+    # run's results, and with a real exception — an assert would vanish
+    # under python -O, which is exactly how the gate would rot
+    problems = artifact_schema_problems(artifact)
+    print(json.dumps(artifact))
     # compact repeat LAST so a tail-window capture always retains the
     # headline (round-4 verdict weak #4); same contract keys + the
     # scale figures the judge reads first
@@ -2394,7 +2684,15 @@ def main(smoke: bool = False) -> None:
         "scale_100m_ingest_events_per_sec":
             None if scale100 is None
             else scale100["ingest_events_per_sec"],
+        "scale_1b_ingest_events_per_sec":
+            None if scale1b is None
+            else scale1b["ingest_events_per_sec"],
+        "scale_1b_shards": None if scale1b is None
+        else scale1b["shards"],
+        "scale_1b_zero_compiles": None if scale1b is None
+        else scale1b["zero_compile_steady_state"],
         "quality_precision_at_10": quality["precision_at_10"],
+        "quality_ndcg_at_10": quality["ndcg_at_10"],
         "train_ckpt_overhead_frac": train_resume["overhead_frac"],
         "train_ckpt_overhead_gate": train_resume["overhead_gate_pass"],
         "train_resume_equal": train_resume["resumed_equal"],
@@ -2408,6 +2706,10 @@ def main(smoke: bool = False) -> None:
             serving_load["max_sustainable_qps"],
         "serving_load_zero_compiles":
             serving_load["zero_compile_steady_state"],
+        "serving_sharded_p50_ms": serving_load_sharded["p50_ms"],
+        "serving_sharded_shards": serving_load_sharded["serve_shards"],
+        "serving_sharded_zero_compiles":
+            serving_load_sharded["zero_compile_steady_state"],
         "seqrec_train_tokens_per_sec":
             seqrec_train["tokens_per_sec"],
         "seqrec_fresh_jit_compile_sec":
@@ -2438,9 +2740,16 @@ def main(smoke: bool = False) -> None:
         "foldin_failed_or_torn_queries":
             foldin["failed_or_torn_queries"],
     }))
+    if problems:
+        raise RuntimeError(
+            f"bench artifact schema violations: {problems}")
 
 
 if __name__ == "__main__":
     import sys
 
-    main(smoke="--smoke" in sys.argv[1:])
+    if "--device-audit" in sys.argv[1:]:
+        _device_watchdog()
+        device_audit()
+    else:
+        main(smoke="--smoke" in sys.argv[1:])

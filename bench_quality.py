@@ -97,24 +97,64 @@ def build_split(n_users: int, n_items: int, nnz: int, seed: int,
     return rows[keep], cols[keep], vals[keep], held
 
 
+def _masked_scores(user_factors: np.ndarray, item_factors: np.ndarray,
+                   train_rows: np.ndarray,
+                   train_cols: np.ndarray) -> np.ndarray:
+    """The dense score matrix both metrics rank from, seen pairs masked
+    — computed ONCE per (factors, split) and shared (the O(U*I*R)
+    matmul dominates the quality check at the ML-1M shape)."""
+    scores = user_factors @ item_factors.T
+    scores[train_rows, train_cols] = -np.inf  # never recommend seen items
+    return scores
+
+
 def precision_at_k(user_factors: np.ndarray, item_factors: np.ndarray,
                    train_rows: np.ndarray, train_cols: np.ndarray,
-                   held: Dict[int, set], k: int = K) -> float:
+                   held: Dict[int, set], k: int = K,
+                   scores: np.ndarray = None) -> float:
     """Mean over holdout users of |top-k unseen| ∩ held| / k — the
-    template's PrecisionAtK on the model's own top-N serving logic."""
+    template's PrecisionAtK on the model's own top-N serving logic.
+    ``scores`` short-circuits the matmul with a precomputed
+    :func:`_masked_scores` matrix."""
     if not held:
         raise ValueError(
             "no holdout users — the (n_users, n_items, nnz) shape is too "
             "sparse for the leave-last-out protocol (need >=5 distinct "
             "items per user)")
-    scores = user_factors @ item_factors.T
-    scores[train_rows, train_cols] = -np.inf  # never recommend seen items
+    if scores is None:
+        scores = _masked_scores(user_factors, item_factors, train_rows,
+                                train_cols)
     users = np.fromiter(held.keys(), dtype=np.int64, count=len(held))
     top = np.argpartition(-scores[users], k, axis=1)[:, :k]
     hits = np.fromiter(
         (len(set(top[i].tolist()) & held[u]) for i, u in enumerate(users)),
         dtype=np.float64, count=len(users))
     return float(hits.mean() / k)
+
+
+def ndcg_at_k_factors(user_factors: np.ndarray, item_factors: np.ndarray,
+                      train_rows: np.ndarray, train_cols: np.ndarray,
+                      held: Dict[int, set], k: int = K,
+                      scores: np.ndarray = None) -> float:
+    """Mean NDCG@k over holdout users — the rank-sensitive companion to
+    :func:`precision_at_k` (same split, same seen masking; the shared
+    metric math lives in ``data.sliding.ndcg_at_k``)."""
+    from predictionio_tpu.data.sliding import ndcg_at_k
+
+    if not held:
+        raise ValueError(
+            "no holdout users — the (n_users, n_items, nnz) shape is too "
+            "sparse for the leave-last-out protocol")
+    if scores is None:
+        scores = _masked_scores(user_factors, item_factors, train_rows,
+                                train_cols)
+    total = 0.0
+    for u in held:
+        row = scores[u]
+        top = np.argpartition(-row, k)[:k]
+        top = top[np.argsort(-row[top], kind="stable")]
+        total += ndcg_at_k(top.tolist(), held[u], k)
+    return float(total / len(held))
 
 
 def popularity_precision(train_rows: np.ndarray, train_cols: np.ndarray,
@@ -195,8 +235,13 @@ def run(n_users: int = None, n_items: int = None, nnz: int = None,
     params = ALSParams(rank=RANK, num_iterations=ITERATIONS, lambda_=LAMBDA,
                        alpha=ALPHA, implicit_prefs=True, seed=3)
     X_dev, Y_dev = train_als(user_side, item_side, params)
-    p_dev = precision_at_k(np.asarray(X_dev), np.asarray(Y_dev),
-                           rows, cols, held)
+    dev_scores = _masked_scores(np.asarray(X_dev), np.asarray(Y_dev),
+                                rows, cols)
+    p_dev = precision_at_k(X_dev, Y_dev, rows, cols, held,
+                           scores=dev_scores)
+    n_dev = ndcg_at_k_factors(X_dev, Y_dev, rows, cols, held,
+                              scores=dev_scores)
+    del dev_scores
 
     t0 = time.perf_counter()
     X_cpu, Y_cpu = train_als_numpy(user_side, item_side, RANK, ITERATIONS,
@@ -224,6 +269,7 @@ def run(n_users: int = None, n_items: int = None, nnz: int = None,
         # bug; the band + popularity floor below speak to quality
         "check": "numerics_parity",
         "precision_at_10": round(p_dev, 4),
+        "ndcg_at_10": round(n_dev, 4),
         "cpu_reference_precision_at_10": round(p_cpu, 4),
         "ratio_vs_cpu": round(p_dev / p_cpu, 3) if p_cpu > 0 else None,
         "seed_band_precision_at_10": {
@@ -395,15 +441,21 @@ def run_seqrec_check(n_users: int = 200, n_items: int = 100,
 
     # model Precision@k: the held-out next item against the top-k of
     # UNSEEN items (the template's seen-mask semantics)
+    from predictionio_tpu.data.sliding import ndcg_at_k
+
     pop = np.bincount(np.concatenate(seqs), minlength=n_items)
     pop_order = np.argsort(-pop).tolist()
     hits = pop_hits = 0
+    ndcg_total = 0.0
     for u in range(n_users):
         seen = set(seqs[u].tolist())
         scores = E @ U[u]
         scores[list(seen)] = -np.inf
-        top = set(np.argpartition(-scores, k)[:k].tolist())
+        top_idx = np.argpartition(-scores, k)[:k]
+        top_idx = top_idx[np.argsort(-scores[top_idx], kind="stable")]
+        top = set(top_idx.tolist())
         hits += next_item[u] in top
+        ndcg_total += ndcg_at_k(top_idx.tolist(), {next_item[u]}, k)
         pop_top = set()
         for i in pop_order:
             if i not in seen:
@@ -419,6 +471,7 @@ def run_seqrec_check(n_users: int = 200, n_items: int = 100,
         "loss_last20_mean": round(tail, 4),
         "loss_decreased": tail < head,
         "precision_at_k": round(p_model, 4),
+        "ndcg_at_k": round(ndcg_total / n_users, 4),
         "popularity_precision_at_k": round(p_pop, 4),
         "beats_popularity": p_model > p_pop,
         "k": k, "n_users": n_users, "n_items": n_items,

@@ -80,6 +80,31 @@ def leave_last_out(groups: Dict[K, List[V]]) \
     return train, held
 
 
+def ndcg_at_k(ranked: Sequence, relevant, k: int) -> float:
+    """Binary-relevance NDCG@k of one ranked list (the sequence-aware
+    metric next to Precision@k — rank position matters, so a model
+    that puts the held-out next item FIRST beats one that buries it at
+    position k, which Precision@k cannot distinguish).
+
+    ``ranked`` is the recommendation list best-first; ``relevant`` the
+    held-out item collection (set semantics). DCG uses the standard
+    ``1/log2(rank+1)`` gain; the ideal DCG places all |relevant| items
+    (clipped to k) on top. Empty ``relevant`` returns 0.0 — callers
+    following OptionAverageMetric semantics should skip those instead.
+    """
+    rel = set(relevant)
+    if not rel:
+        return 0.0
+    k = int(k)
+    dcg = 0.0
+    for pos, item in enumerate(ranked[:k]):
+        if item in rel:
+            dcg += 1.0 / np.log2(pos + 2.0)
+    ideal = sum(1.0 / np.log2(pos + 2.0)
+                for pos in range(min(k, len(rel))))
+    return float(dcg / ideal)
+
+
 def group_by_entity(entities: Sequence, payloads: Sequence[V]) \
         -> Dict[str, List[V]]:
     """Group aligned (entity, payload) rows into an insertion-ordered
@@ -91,4 +116,5 @@ def group_by_entity(entities: Sequence, payloads: Sequence[V]) \
     return groups
 
 
-__all__ = ["sliding_window_masks", "leave_last_out", "group_by_entity"]
+__all__ = ["sliding_window_masks", "leave_last_out", "group_by_entity",
+           "ndcg_at_k"]

@@ -188,13 +188,14 @@ class FoldInConsumer:
                 "deploy with --foldin on (forces DeviceTopK) and drop "
                 "PIO_SERVING_BACKEND=host")
         if not getattr(server, "growable", True):
-            # refuse at deploy, not first unknown user: a sharded
-            # store's growth refusal inside a fold would poison every
-            # batch that contains a new user
+            # refuse at deploy, not first unknown user: a non-growable
+            # store's refusal inside a fold would poison every batch
+            # that contains a new user. (Mesh-sharded DeviceTopK stores
+            # grow by RESHARDING since ISSUE 15, so sharded deploys
+            # fold in like single-chip ones.)
             raise ValueError(
                 "online fold-in requires a growable user factor store; "
-                "mesh-sharded models grow at retrain only — deploy "
-                "without --foldin on sharded models")
+                f"{type(server).__name__} cannot grow its user rows")
         self._scope = app_name_to_id(self._cfg.app_name,
                                      self._cfg.channel_name)
         self._cursor = self._levents().tail_cursor(*self._scope)

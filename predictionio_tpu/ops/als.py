@@ -1484,6 +1484,27 @@ def fold_in_users(item_factors, cols_list: Sequence[np.ndarray],
     return np.asarray(out[:k], dtype=np.float32)
 
 
+def item_interaction_counts(item_side) -> np.ndarray:
+    """Per-item interaction counts from an ITEM-side table (rows are
+    items) — the density signal the ALX-style bin-pack shards by
+    (``parallel.als_sharding.density_aware_item_layout``). Accepts a
+    uniform :class:`PaddedRatings` or a :class:`BucketedRatings`;
+    sentinel pad rows contribute nothing."""
+    if isinstance(item_side, BucketedRatings):
+        counts = np.zeros(item_side.n_rows, dtype=np.int64)
+        for b in item_side.buckets:
+            ids = np.asarray(b.row_ids, dtype=np.int64)
+            # reduce BEFORE np.asarray: device-staged tables (the 1B
+            # lane) transfer one [rows] vector, not the padded mask
+            per_row = np.asarray(
+                b.mask.sum(axis=1)).astype(np.int64)
+            real = ids < item_side.n_rows
+            np.add.at(counts, ids[real], per_row[real])
+        return counts
+    per_row = np.asarray(item_side.mask.sum(axis=1)).astype(np.int64)
+    return per_row[:item_side.n_rows]
+
+
 # ---------------------------------------------------------------------------
 # Scoring / prediction helpers
 # ---------------------------------------------------------------------------

@@ -385,7 +385,7 @@ def _topk_select_body(scores, item_ids, run_v, run_i, buf_v, buf_i, K):
     jax.lax.fori_loop(0, K, sel, 0)
 
 
-def _fused_topk_body(q_ref, yd_ref, ys_ref, sc_ref, sm_ref,
+def _fused_topk_body(q_ref, yd_ref, ys_ref, rv_ref, sc_ref, sm_ref,
                      vals_ref, idx_ref, run_v, run_i, buf_v, buf_i,
                      *, K, n_items, n_tiles, mask_seen):
     """One grid step = one ``[TM, R]`` item tile scored, masked, and
@@ -418,6 +418,11 @@ def _fused_topk_body(q_ref, yd_ref, ys_ref, sc_ref, sm_ref,
     item_ids = jax.lax.broadcasted_iota(jnp.int32, (TM, 1), 0) + off
     # padded factor rows (index >= n_items) never reach the top-k
     scores = jnp.where(item_ids < n_items, scores, -jnp.inf)
+    if rv_ref is not None:
+        # per-row validity column (density-sharded stores: a shard's
+        # real items are bin-packed, not a contiguous prefix, so a
+        # static n_items bound cannot express them)
+        scores = jnp.where(rv_ref[:] > 0, scores, -jnp.inf)
     if mask_seen:
         L = sc_ref.shape[0]
 
@@ -447,6 +452,7 @@ def _fused_topk_body(q_ref, yd_ref, ys_ref, sc_ref, sm_ref,
 
 def fused_gather_score_topk(Q, Y, seen_cols, seen_mask, *, k: int,
                             n_items: int, mask_seen: bool = True,
+                            row_valid=None,
                             interpret: Optional[bool] = None,
                             tile_m: Optional[int] = None):
     """The fused serving program: ``top_k(mask(Y @ Q^T))`` with the
@@ -458,7 +464,10 @@ def fused_gather_score_topk(Q, Y, seen_cols, seen_mask, *, k: int,
     ``[M, R]`` fp32/bf16 table or an int8
     :class:`~predictionio_tpu.ops.quantize.QuantFactors` whose per-row
     scales dequantize in VMEM; ``seen_cols``/``seen_mask`` ``[L, B]``
-    per-query masked item ids (ignored when ``mask_seen`` is False).
+    per-query masked item ids (ignored when ``mask_seen`` is False);
+    ``row_valid`` an optional ``[M]`` per-row validity vector (>0 =
+    real item) for stores whose real rows are not a contiguous prefix
+    — the density-sharded per-shard lane.
 
     Returns ``(vals [B, k] f32, idx [B, k] i32)``, rows descending,
     -inf past the valid candidates — the same contract as the XLA
@@ -500,6 +509,13 @@ def fused_gather_score_topk(Q, Y, seen_cols, seen_mask, *, k: int,
                          constant_values=1.0)
         in_specs.append(pl.BlockSpec((TM, 1), lambda t: (t, 0)))
         args.append(ys)
+    has_valid = row_valid is not None
+    if has_valid:
+        rv = jnp.asarray(row_valid, dtype=jnp.float32)[:, None]
+        if padM:
+            rv = jnp.pad(rv, ((0, padM), (0, 0)))  # pad rows invalid
+        in_specs.append(pl.BlockSpec((TM, 1), lambda t: (t, 0)))
+        args.append(rv)
     if mask_seen:
         L = seen_cols.shape[0]
         sc = jnp.asarray(seen_cols, dtype=jnp.int32)
@@ -521,12 +537,16 @@ def fused_gather_score_topk(Q, Y, seen_cols, seen_mask, *, k: int,
         if quant:
             ysr = refs[pos]
             pos += 1
+        rvr = None
+        if has_valid:
+            rvr = refs[pos]
+            pos += 1
         scr = smr = None
         if mask_seen:
             scr, smr = refs[pos], refs[pos + 1]
             pos += 2
         vals_ref, idx_ref, run_v, run_i, buf_v, buf_i = refs[pos:]
-        _fused_topk_body(qr, ydr, ysr, scr, smr, vals_ref, idx_ref,
+        _fused_topk_body(qr, ydr, ysr, rvr, scr, smr, vals_ref, idx_ref,
                          run_v, run_i, buf_v, buf_i, K=K,
                          n_items=n_items, n_tiles=n_tiles,
                          mask_seen=mask_seen)

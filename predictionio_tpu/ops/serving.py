@@ -135,6 +135,26 @@ def _serve_kernel_mode() -> str:
         "(expected one of: auto, fused, xla)")
 
 
+def _serve_shards_env() -> int:
+    """``PIO_SERVE_SHARDS`` — shard the DEVICE factor store over this
+    many devices (density-aware item placement when the model carries
+    interaction counts; see ``parallel.als_sharding``). 0/unset keeps
+    the single-store layout; like the bf16/int8 policies it is an HBM
+    policy, so any value > 1 forces the device backend in auto mode and
+    conflicts loudly with an explicit host backend."""
+    import os
+
+    raw = os.environ.get("PIO_SERVE_SHARDS", "").strip()
+    if not raw:
+        return 0
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"PIO_SERVE_SHARDS={raw!r} is not an integer shard count")
+    return max(0, n)
+
+
 def foldin_enabled() -> bool:
     """``PIO_FOLDIN`` — set by ``pio deploy --foldin on`` (and readable
     directly by embedders): the deployed server runs the online fold-in
@@ -284,6 +304,151 @@ def _pad_item_rows_for_kernel(Y):
                 [Y.data, jnp.zeros((pad, Y.data.shape[1]), Y.data.dtype)]),
             jnp.concatenate([Y.scale, jnp.ones((pad,), Y.scale.dtype)]))
     return jnp.concatenate([Y, jnp.zeros((pad, Y.shape[1]), Y.dtype)])
+
+
+# ---------------------------------------------------------------------------
+# Sharded serving (ISSUE 15): per-shard top-k + on-device log-tree merge
+# ---------------------------------------------------------------------------
+
+
+def _dim0_shard_ctx(arr) -> Optional[Tuple[Any, str]]:
+    """(mesh, axis) when ``arr``'s leading dim is sharded over exactly
+    one mesh axis of size > 1 — the serve-shard context a pre-sharded
+    PAlgorithm store carries in its own placement; None otherwise."""
+    from jax.sharding import NamedSharding
+
+    sh = getattr(arr, "sharding", None)
+    if not isinstance(sh, NamedSharding) or sh.mesh.devices.size <= 1:
+        return None
+    spec = sh.spec
+    dim0 = spec[0] if len(spec) else None
+    names = (dim0,) if isinstance(dim0, str) else tuple(dim0 or ())
+    if len(names) != 1:
+        return None
+    axis = names[0]
+    if int(sh.mesh.shape[axis]) <= 1:
+        return None
+    return sh.mesh, axis
+
+
+def _tree_merge_topk(vals, idx, k: int, axis: str, n_sh: int):
+    """Merge per-shard top-k candidate lists into the GLOBAL top-k on
+    device — the PR-6 ``pio_merge_runs`` k-way-merge idiom re-expressed
+    on HBM. Power-of-two shard counts run a butterfly of ``ppermute``
+    exchanges (log2(n) rounds, each merging two sorted k-lists via one
+    ``top_k`` over 2k candidates; the lower shard's candidates lead the
+    union so score ties resolve identically on every device); other
+    counts take one ``all_gather`` + top_k over n*k candidates. Either
+    way the merged (vals, idx) land replicated on every shard and only
+    the k winners ever travel to host."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if n_sh & (n_sh - 1) == 0:
+        me = lax.axis_index(axis)
+        step = 1
+        while step < n_sh:
+            perm = [(i, i ^ step) for i in range(n_sh)]
+            ov = lax.ppermute(vals, axis, perm)
+            oi = lax.ppermute(idx, axis, perm)
+            mine_first = (me & step) == 0
+            cv = jnp.where(mine_first,
+                           jnp.concatenate([vals, ov], axis=-1),
+                           jnp.concatenate([ov, vals], axis=-1))
+            ci = jnp.where(mine_first,
+                           jnp.concatenate([idx, oi], axis=-1),
+                           jnp.concatenate([oi, idx], axis=-1))
+            vals, sel = lax.top_k(cv, k)
+            idx = jnp.take_along_axis(ci, sel, axis=-1)
+            step *= 2
+        return vals, idx
+    av = lax.all_gather(vals, axis, axis=0)            # [n_sh, B, k]
+    ai = lax.all_gather(idx, axis, axis=0)
+    av = jnp.moveaxis(av, 0, -2).reshape(
+        vals.shape[:-1] + (n_sh * k,))
+    ai = jnp.moveaxis(ai, 0, -2).reshape(
+        idx.shape[:-1] + (n_sh * k,))
+    v, sel = lax.top_k(av, k)
+    return v, jnp.take_along_axis(ai, sel, axis=-1)
+
+
+def _sharded_score_topk(Y, valid, Q, sc_q, sm_q, *, k: int,
+                        mask_seen: bool, mode: str, mesh, axis: str,
+                        fused: bool, interpret: bool):
+    """Score + mask + top-k over a mesh-sharded item store, explicitly:
+    ``shard_map`` gives each shard its ``[m_local, R]`` factor block,
+    the shard scores it against the replicated queries (XLA chain, or
+    the fused Pallas kernel running per-shard on its local tiles),
+    masks invalid positions (``valid`` — the density layout's real-item
+    mask) and out-of-shard seen ids, takes its local ``lax.top_k``, and
+    the per-shard runs merge on device (:func:`_tree_merge_topk`).
+
+    ``Q [B, R]`` fp32 replicated queries; ``sc_q``/``sm_q`` ``[B, L]``
+    per-query masked POSITIONS (+ mask) in the store's layout. Returns
+    ``(vals [B, k] f32, positions [B, k] i32)`` replicated."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from predictionio_tpu.ops.quantize import QuantFactors, is_quantized
+
+    n_sh = int(mesh.shape[axis])
+    quant = is_quantized(Y)
+
+    def body(Yd, Ys, vl, Qb, scq, smq):
+        m = int(Yd.shape[0])
+        off = lax.axis_index(axis) * m
+        loc = scq - off                 # [B, L] shard-local seen ids
+        in_shard = (loc >= 0) & (loc < m) & (smq > 0)
+        kl = min(k, m)
+        if fused:
+            from predictionio_tpu.ops.als_pallas import (
+                fused_gather_score_topk,
+            )
+
+            Yl = QuantFactors(Yd, Ys) if quant else Yd
+            vals, li = fused_gather_score_topk(
+                Qb, Yl, jnp.where(in_shard, loc, -1).T,
+                in_shard.T.astype(jnp.float32), k=kl, n_items=m,
+                mask_seen=mask_seen, row_valid=vl,
+                interpret=interpret)
+        else:
+            if quant:
+                # dequant into the fp32 accumulate locally (the int8
+                # HBM stream stays int8 per shard, like the fused tile)
+                Yf = Yd.astype(jnp.float32) * Ys[:, None]
+                scores = jnp.einsum(
+                    "mr,br->bm", Yf, Qb,
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)
+            else:
+                scores = _score_einsum("mr,br->bm", Yd, Qb, mode=mode)
+            scores = jnp.where(vl[None, :] > 0, scores, -jnp.inf)
+            if mask_seen:
+                lc = jnp.clip(loc, 0, m - 1)
+                add = jnp.where(in_shard, -jnp.inf, 0.0)
+                scores = jax.vmap(
+                    lambda s, i, a: s.at[i].add(a))(scores, lc, add)
+            vals, li = lax.top_k(scores, kl)
+        if kl < k:                      # tiny shard: pad candidates
+            vals = jnp.pad(vals, ((0, 0), (0, k - kl)),
+                           constant_values=-jnp.inf)
+            li = jnp.pad(li, ((0, 0), (0, k - kl)))
+        return _tree_merge_topk(vals, li + off, k, axis, n_sh)
+
+    row, col, repl = P(axis, None), P(axis), P(None, None)
+    if quant:
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(row, col, col, repl, repl, repl),
+                       out_specs=(repl, repl), check_rep=False)
+        return fn(Y.data, Y.scale, valid, Q, sc_q, sm_q)
+    fn = shard_map(
+        lambda Yd, vl, Qb, scq, smq: body(Yd, None, vl, Qb, scq, smq),
+        mesh=mesh, in_specs=(row, col, repl, repl, repl),
+        out_specs=(repl, repl), check_rep=False)
+    return fn(Y, valid, Q, sc_q, sm_q)
 
 
 def _user_topk(X, Y, seen_cols, seen_mask, uid, *, k: int, mask_seen: bool,
@@ -532,6 +697,7 @@ def choose_server(user_factors, item_factors,
     explicit = _serve_precision_explicit()
     hbm_policy_serve = explicit in ("bf16", "int8")
     foldin = foldin_enabled()
+    sharded = _serve_shards_env() > 1
     host_capable = not (hasattr(user_factors, "sharding")
                         or hasattr(item_factors, "sharding"))
     if backend == "host":
@@ -550,8 +716,13 @@ def choose_server(user_factors, item_factors,
                 "online fold-in patches the DEVICE factor store in place "
                 "(DeviceTopK.patch_users); host serving has no updatable "
                 "store")
+        if sharded:
+            raise ValueError(
+                "PIO_SERVE_SHARDS conflicts with PIO_SERVING_BACKEND="
+                "host: sharding the factor store over a mesh is a "
+                "device (HBM) policy; host serving has one store")
         cls = HostTopK
-    elif backend == "device" or hbm_policy_serve or foldin:
+    elif backend == "device" or hbm_policy_serve or foldin or sharded:
         cls = DeviceTopK
     else:
         if host_capable:
@@ -1284,7 +1455,9 @@ class DeviceTopK:
                  seen: Optional[Dict[int, np.ndarray]] = None,
                  n_users: Optional[int] = None,
                  n_items: Optional[int] = None,
-                 microbatch: Optional[bool] = None):
+                 microbatch: Optional[bool] = None,
+                 item_layout=None,
+                 shards: Optional[int] = None):
         import os
 
         import jax.numpy as jnp
@@ -1350,33 +1523,52 @@ class DeviceTopK:
                 self._X = quantize_rows_int8(self._X)
             if not is_quantized(self._Y):
                 self._Y = quantize_rows_int8(self._Y)
-        # which top-k program family serves: the fused Pallas kernel
-        # (one program: gather -> score -> mask -> top-k, item tiles
-        # stream HBM->VMEM exactly once) or the XLA chain. The fused
-        # kernel is single-chip — mesh-sharded stores keep the XLA
-        # chain, whose matmul XLA partitions across the mesh.
-        self._kernel = _serve_kernel_mode()
-        if self._kernel == "fused":
-            sh = getattr(self._X, "sharding", None)
-            if sh is not None and getattr(
-                    getattr(sh, "mesh", None), "devices",
-                    np.empty(1)).size > 1:
-                self._kernel = "xla"
         # factor tables may be padded (sharded training pads rows);
         # n_users/n_items bound the valid index range
         self.n_users = int(n_users if n_users is not None
                            else self._X.shape[0])
         self.n_items = int(n_items if n_items is not None
                            else self._Y.shape[0])
-        if self._kernel == "fused":
+        # sharded live plane (ISSUE 15): an explicit layout / shard
+        # count re-places the store density-aware over a serve mesh; a
+        # pre-sharded PAlgorithm store keeps its own placement. Either
+        # way every top-k dispatches per-shard + on-device merge.
+        self._shard: Optional[Tuple[Any, str, int]] = None
+        self._layout = None
+        self._perm_np: Optional[np.ndarray] = None
+        self._inv_np: Optional[np.ndarray] = None
+        self._valid = None
+        self._setup_sharded_store(item_layout, shards, seen)
+        # which top-k program family serves: the fused Pallas kernel
+        # (one program: gather -> score -> mask -> top-k, item tiles
+        # stream HBM->VMEM exactly once) or the XLA chain. On a
+        # mesh-sharded store both run PER SHARD under shard_map with
+        # the log-tree merge on top (hard part #5).
+        self._kernel = _serve_kernel_mode()
+        if self._kernel == "fused" and self._shard is None:
+            # mesh-committed factors WITHOUT a shard context (dim0
+            # replicated, or sharded over >1 axis): the per-shard lane
+            # cannot express them and the single-chip fused kernel must
+            # not run on multi-device arrays — keep the XLA chain, as
+            # before ISSUE 15
+            for f in (self._X, self._Y):
+                sh = getattr(f, "sharding", None)
+                if sh is not None and getattr(
+                        getattr(sh, "mesh", None), "devices",
+                        np.empty(1)).size > 1:
+                    self._kernel = "xla"
+                    break
+        if self._kernel == "fused" and self._shard is None:
             # pad the item table ONCE to the kernel's tile multiple so
             # no dispatch ever pays a per-call copy; padded rows sit
             # past n_items and are masked on device like any training
-            # padding
+            # padding (sharded stores pad per shard inside the kernel
+            # call — their cap is the layout's, not the tile's)
             self._Y = _pad_item_rows_for_kernel(self._Y)
         self._mask_seen = bool(seen)
         if self._mask_seen:
-            cols, mask = seen_tables(seen, self._X.shape[0])
+            cols, mask = seen_tables(self._translate_seen(seen),
+                                     int(self._X.shape[0]))
         else:
             cols = np.zeros((1, 1), dtype=np.int32)
             mask = np.zeros((1, 1), dtype=np.float32)
@@ -1385,9 +1577,10 @@ class DeviceTopK:
         self._user_programs: Dict[int, object] = {}
         self._batch_programs: Dict[Tuple[int, int], object] = {}
         self._item_programs: Dict[object, object] = {}
-        # fused-kernel jit programs are shape-polymorphic over the uid
-        # bucket, so the user lanes cache per k-bucket only
+        # fused-kernel and sharded jit programs are shape-polymorphic
+        # over the uid bucket, so those lanes cache per k-bucket only
         self._fused_programs: Dict[object, object] = {}
+        self._shard_programs: Dict[object, object] = {}
         # AOT-compiled ladder executables (warmup/precompile): keyed by
         # (store signature, program shape) so a store reshaped by
         # fold-in growth can never hit a stale executable — the jit
@@ -1410,6 +1603,136 @@ class DeviceTopK:
         _metrics.DEVICE_STORE_BYTES.set_function(_live_store_bytes)
         _metrics.AOT_LADDER_BYTES.set_function(_live_ladder_bytes)
 
+    def _setup_sharded_store(self, item_layout, shards: Optional[int],
+                             seen) -> None:
+        """Resolve the shard context and (re)place the factor store.
+
+        Three lanes: (1) an explicit ``item_layout`` / ``shards`` /
+        ``PIO_SERVE_SHARDS`` re-places the store onto a 1-D serve mesh
+        in the density-aware item order (counts derived from ``seen``
+        when no layout came with the model — the seen sets ARE the
+        interaction sets); (2) a store whose arrays arrive mesh-sharded
+        (PAlgorithm) keeps its own placement, positions == item ids;
+        (3) single-device stores leave ``self._shard`` None."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from predictionio_tpu.ops.quantize import (
+            QuantFactors,
+            is_quantized,
+        )
+
+        n_req = int(shards) if shards is not None else _serve_shards_env()
+        if item_layout is not None and n_req <= 1:
+            n_req = item_layout.n_shards
+        if n_req > 1:
+            ndev = len(jax.devices())
+            if ndev < n_req:
+                # a 1-device smoke host still runs the sharded lane —
+                # degraded to what the hardware has, loudly
+                import logging
+
+                logging.getLogger("pio.serving").warning(
+                    "requested %d serve shards but only %d device(s) "
+                    "are visible; clamping", n_req, ndev)
+                n_req = ndev
+        if n_req > 1:
+            from predictionio_tpu.parallel.als_sharding import (
+                density_aware_item_layout,
+            )
+            from predictionio_tpu.parallel.mesh import data_parallel_mesh
+
+            layout = item_layout
+            if layout is None or layout.n_shards != n_req:
+                counts = np.zeros(self.n_items, dtype=np.int64)
+                if seen:
+                    for items in seen.values():
+                        it = np.asarray(items, dtype=np.int64)
+                        it = it[(it >= 0) & (it < self.n_items)]
+                        np.add.at(counts, it, 1)
+                layout = density_aware_item_layout(counts, n_req)
+            mesh = data_parallel_mesh(layout.n_shards)
+            axis = "data"
+            row = NamedSharding(mesh, P(axis, None))
+            col = NamedSharding(mesh, P(axis))
+            put = jax.device_put
+
+            def perm_rows(a, fill):
+                a = jnp.asarray(a)
+                idx = jnp.asarray(np.clip(layout.perm, 0,
+                                          max(int(a.shape[0]) - 1, 0)))
+                out = jnp.take(a, idx, axis=0)
+                real = jnp.asarray(layout.perm >= 0)
+                real = real[(slice(None),) + (None,) * (out.ndim - 1)]
+                return jnp.where(real, out,
+                                 jnp.asarray(fill, dtype=out.dtype))
+
+            def pad_rows(a, fill):
+                a = jnp.asarray(a)
+                pad = (-int(a.shape[0])) % layout.n_shards
+                if pad:
+                    a = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1),
+                                constant_values=fill)
+                return a
+
+            if is_quantized(self._Y):
+                self._Y = QuantFactors(
+                    put(perm_rows(self._Y.data, 0), row),
+                    put(perm_rows(self._Y.scale, 1.0), col))
+            else:
+                self._Y = put(perm_rows(self._Y, 0.0), row)
+            if is_quantized(self._X):
+                self._X = QuantFactors(
+                    put(pad_rows(self._X.data, 0), row),
+                    put(pad_rows(self._X.scale, 1.0), col))
+            else:
+                self._X = put(pad_rows(self._X, 0.0), row)
+            self._shard = (mesh, axis, layout.n_shards)
+            self._layout = layout
+            self._perm_np = layout.perm
+            self._inv_np = layout.inv
+            self._valid = put(jnp.asarray(layout.valid_mask()), col)
+            return
+        ctx = _dim0_shard_ctx(self._Y)
+        if ctx is not None:
+            mesh, axis = ctx
+            n_sh = int(mesh.shape[axis])
+            self._shard = (mesh, axis, n_sh)
+            n_pos = int(self._Y.shape[0])
+            valid = (np.arange(n_pos) < self.n_items).astype(np.float32)
+            self._valid = jax.device_put(
+                jnp.asarray(valid), NamedSharding(mesh, P(axis)))
+
+    def _translate_seen(self, seen):
+        """Item-id seen sets -> store-position seen sets (identity
+        without a density layout). Ids outside [0, n_items) are dropped
+        — they carry no position."""
+        if self._inv_np is None or not seen:
+            return seen
+        inv = self._inv_np
+        out = {}
+        for u, items in seen.items():
+            it = np.asarray(items, dtype=np.int64)
+            it = it[(it >= 0) & (it < self.n_items)]
+            out[u] = inv[it]
+        return out
+
+    def _positions_to_items(self, idx: np.ndarray) -> np.ndarray:
+        """Store positions (device top-k output) -> item ids, host-side
+        (k elements per query — negligible next to the fetch). Pad
+        positions map to -1; their scores are -inf and every caller
+        filters non-finite rows."""
+        if self._perm_np is None:
+            return idx
+        return self._perm_np[idx].astype(np.int32)
+
+    def _items_to_positions(self, idxs: np.ndarray) -> np.ndarray:
+        """Item ids (similarity-query input) -> store positions."""
+        if self._inv_np is None:
+            return idxs
+        return self._inv_np[idxs].astype(np.int32)
+
     def _replicate_like_factors(self, arr):
         """When the factors are sharded over a mesh, pin auxiliary tables
         replicated on the SAME mesh so one jitted program sees consistent
@@ -1417,6 +1740,9 @@ class DeviceTopK:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        if self._shard is not None:
+            mesh = self._shard[0]
+            return jax.device_put(arr, NamedSharding(mesh, P(None, None)))
         sh = getattr(self._X, "sharding", None)
         if isinstance(sh, NamedSharding) and sh.mesh.devices.size > 1:
             return jax.device_put(arr, NamedSharding(sh.mesh, P(None, None)))
@@ -1488,7 +1814,69 @@ class DeviceTopK:
             self._fused_programs[("i", kb)] = prog
         return prog
 
+    def _sharded_user_program(self, kb: int):
+        """User-lane serving over the sharded store: gather (sharded,
+        GSPMD) the query users' fp32 rows + their seen rows, then the
+        explicit per-shard score/mask/top-k + log-tree merge
+        (:func:`_sharded_score_topk`). Shape-polymorphic over the uid
+        bucket (scalar included), cached per k bucket."""
+        prog = self._shard_programs.get(("u", kb))
+        if prog is None:
+            import jax
+            import jax.numpy as jnp
+
+            mode, mask_seen = self._mode, self._mask_seen
+            mesh, axis, _ = self._shard
+            fused = self._kernel == "fused"
+            interpret = jax.default_backend() != "tpu"
+
+            @jax.jit
+            def prog(X, Y, valid, sc, sm, uids):
+                scalar = jnp.ndim(uids) == 0
+                u = uids[None] if scalar else uids
+                Q = _gather_rows_f32(X, u, mode=mode)
+                scq = jnp.take(sc, u, axis=0)
+                smq = jnp.take(sm, u, axis=0)
+                vals, pos = _sharded_score_topk(
+                    Y, valid, Q, scq, smq, k=kb, mask_seen=mask_seen,
+                    mode=mode, mesh=mesh, axis=axis, fused=fused,
+                    interpret=interpret)
+                packed = _pack(vals, pos)
+                return packed[0] if scalar else packed
+
+            self._shard_programs[("u", kb)] = prog
+        return prog
+
+    def _sharded_items_program(self, kb: int):
+        """Item-similarity serving over the sharded store: the [G, B]
+        query bucket reduces to one summed normalized row per group,
+        then the same per-shard score + merge with the query items
+        masked (their position/mask table plays the seen-table role)."""
+        prog = self._shard_programs.get(("i", kb))
+        if prog is None:
+            import jax
+
+            mode = self._mode
+            mesh, axis, _ = self._shard
+            fused = self._kernel == "fused"
+            interpret = jax.default_backend() != "tpu"
+
+            @jax.jit
+            def prog(Yn, valid, idxs, masks):
+                qf = _gather_rows_f32(Yn, idxs, mode=mode)  # [G, B, R]
+                Q = (qf * masks[..., None]).sum(axis=1)      # [G, R]
+                vals, pos = _sharded_score_topk(
+                    Yn, valid, Q, idxs, masks, k=kb, mask_seen=True,
+                    mode=mode, mesh=mesh, axis=axis, fused=fused,
+                    interpret=interpret)
+                return _pack(vals, pos)
+
+            self._shard_programs[("i", kb)] = prog
+        return prog
+
     def _user_program(self, k: int):
+        if self._shard is not None:
+            return self._sharded_user_program(k)
         if self._kernel == "fused":
             return self._fused_user_program(k)
         import jax
@@ -1505,6 +1893,8 @@ class DeviceTopK:
     def _batch_program(self, k: int, b: int):
         """vmap of the per-user program over a [b] uid vector: b queries,
         one dispatch, one packed [b, 2k] fetch."""
+        if self._shard is not None:
+            return self._sharded_user_program(k)
         if self._kernel == "fused":
             return self._fused_user_program(k)
         import jax
@@ -1520,7 +1910,9 @@ class DeviceTopK:
 
     def _items_program(self, kb: int, B: int, G: int):
         """vmap of the item-similarity program over a [G, B] query
-        bucket (or its fused equivalent)."""
+        bucket (or its fused / sharded equivalent)."""
+        if self._shard is not None:
+            return self._sharded_items_program(kb)
         if self._kernel == "fused":
             return self._fused_items_program(kb)
         import jax
@@ -1557,7 +1949,8 @@ class DeviceTopK:
             return (tuple(f.shape), str(f.dtype))
 
         return (fsig(self._X), fsig(self._Y),
-                tuple(self._seen_cols.shape), self._mode, self._kernel)
+                tuple(self._seen_cols.shape), self._mode, self._kernel,
+                0 if self._shard is None else int(self._shard[2]))
 
     def _aot_get_locked(self, entry: Tuple):
         return self._aot_programs.get((self._store_sig_locked(), entry))
@@ -1626,30 +2019,36 @@ class DeviceTopK:
         with self._store_lock:
             X, Y = self._X, self._Y
             sc, sm = self._seen_cols, self._seen_mask
+            valid = self._valid
             sig = self._store_sig_locked()
         Yn = self._normalized_items() \
             if any(e[0] == "items" for e in plan) else None
+        sharded = self._shard is not None
+        user_pre = (X, Y, valid, sc, sm) if sharded else (X, Y, sc, sm)
+        items_pre = (Yn, valid) if sharded else (Yn,)
 
         def build(entry: Tuple):
-            # the SAME builders the dispatch paths use (XLA chain or
-            # fused kernel per self._kernel), so AOT executables and
-            # jit fallbacks can never encode different programs
+            # the SAME builders the dispatch paths use (XLA chain,
+            # fused kernel, or sharded per self._kernel/_shard), so AOT
+            # executables and jit fallbacks can never encode different
+            # programs
             kind = entry[0]
             if kind == "user":
                 fn = self._user_program(entry[1])
                 return entry, lower_compile(
-                    fn, X, Y, sc, sm,
+                    fn, *user_pre,
                     jax.ShapeDtypeStruct((), jnp.int32))
             if kind == "users":
                 _, kb, bb = entry
                 fn = self._batch_program(kb, bb)
                 return entry, lower_compile(
-                    fn, X, Y, sc, sm,
+                    fn, *user_pre,
                     jax.ShapeDtypeStruct((bb,), jnp.int32))
             _, kb, B, gg = entry
             fn = self._items_program(kb, B, gg)
             return entry, lower_compile(
-                fn, Yn, jax.ShapeDtypeStruct((gg, B), jnp.int32),
+                fn, *items_pre,
+                jax.ShapeDtypeStruct((gg, B), jnp.int32),
                 jax.ShapeDtypeStruct((gg, B), jnp.float32))
 
         compiled = fallback = 0
@@ -1796,13 +2195,20 @@ class DeviceTopK:
         kb = min(_bucket(k), self.n_items)
         out = self._dispatch_entry(
             ("user", kb), lambda: self._user_program(kb),
-            lambda: (self._X, self._Y, self._seen_cols, self._seen_mask,
-                     np.int32(uid)),
+            lambda: self._user_args(np.int32(uid)),
             batch=1, bucket=1)
         idx, scores = _unpack(np.asarray(out), kb)
-        idx, scores = idx[:k], scores[:k]
+        idx, scores = self._positions_to_items(idx[:k]), scores[:k]
         valid = np.isfinite(scores)
         return idx[valid], scores[valid]
+
+    def _user_args(self, uids) -> Tuple:
+        """The user-lane program's argument tuple for the live store
+        (sharded programs additionally take the validity row)."""
+        if self._shard is not None:
+            return (self._X, self._Y, self._valid, self._seen_cols,
+                    self._seen_mask, uids)
+        return (self._X, self._Y, self._seen_cols, self._seen_mask, uids)
 
     def users_topk(self, uids, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """Batched top-k for a vector of user indices: ONE device dispatch
@@ -1824,11 +2230,11 @@ class DeviceTopK:
             kb = min(_bucket(k), self.n_items)
             out = self._dispatch_entry(
                 ("users", kb, bb), lambda: self._batch_program(kb, bb),
-                lambda: (self._X, self._Y, self._seen_cols,
-                         self._seen_mask, padded),
+                lambda: self._user_args(padded),
                 batch=n, bucket=bb)
             idx, scores = _unpack(np.asarray(out), kb)
-            return idx[:n, :k], scores[:n, :k]
+            return (self._positions_to_items(idx[:n, :k]),
+                    scores[:n, :k])
 
     def items_topk(self, idxs, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """Item-similarity top-k for a list of query item indices. With
@@ -1865,16 +2271,32 @@ class DeviceTopK:
         bucket: G concurrent item queries, one dispatch, one fetch."""
         G, B = idxs.shape
         kb = min(_bucket(k), self.n_items)
+        # out-of-range query item ids DROP from the query (mask 0):
+        # on the single-store path jnp.take's NaN fill used to poison
+        # the whole summed query row (one bad id emptied the result),
+        # and on a density-sharded store the inv take would fault
+        in_range = (idxs >= 0) & (idxs < self.n_items)
+        if not in_range.all():
+            masks = masks * in_range.astype(masks.dtype)
+            idxs = np.where(in_range, idxs, 0).astype(idxs.dtype)
+        # density-sharded stores live in position space: translate the
+        # query item ids in, the winners back out (host-side, tiny)
+        idxs = self._items_to_positions(idxs)
         # the [G, B] bucket is already padded — the REAL group size is
         # the dispatcher's, carried in the dispatch context (G itself
         # for direct single-row calls)
         ctx = _dtel.current_dispatch_context() or {}
         out = self._dispatch_entry(
             ("items", kb, B, G), lambda: self._items_program(kb, B, G),
-            lambda: (self._normalized_items(), idxs, masks),
+            lambda: self._items_args(idxs, masks),
             batch=int(ctx.get("group") or G), bucket=G)
         idx, scores = _unpack(np.asarray(out), kb)
-        return idx, scores
+        return self._positions_to_items(idx), scores
+
+    def _items_args(self, idxs, masks) -> Tuple:
+        if self._shard is not None:
+            return (self._normalized_items(), self._valid, idxs, masks)
+        return (self._normalized_items(), idxs, masks)
 
     # -- device-plane accounting (HBM + AOT ladder) ------------------------
 
@@ -1890,6 +2312,7 @@ class DeviceTopK:
             X, Y, Yn = self._X, self._Y, self._Yn
             sc, sm = self._seen_cols, self._seen_mask
             mode, kernel = self._mode, self._kernel
+            shard, layout = self._shard, self._layout
 
         def comp(f) -> Optional[Dict[str, Any]]:
             if f is None:
@@ -1915,7 +2338,7 @@ class DeviceTopK:
         }
         total = sum(c["bytes"] + c.get("scaleBytes", 0)
                     for c in components.values() if c is not None)
-        return {
+        report = {
             "precision": mode,
             "kernel": kernel,
             "nUsers": self.n_users,
@@ -1924,6 +2347,44 @@ class DeviceTopK:
             "components": components,
             "totalBytes": int(total),
         }
+        if shard is not None:
+            # per-shard breakdown (ISSUE 15 satellite): the aggregate
+            # above hides a hot shard — the exact failure density-aware
+            # sharding targets, so the report names each shard's HBM
+            # slice, item count, and interaction mass
+            _, axis, n_sh = shard
+
+            def per_shard(f) -> int:
+                if f is None:
+                    return 0
+                if is_quantized(f):
+                    return (int(f.data.nbytes) + int(f.scale.nbytes)) \
+                        // n_sh
+                return int(f.nbytes) // n_sh
+
+            items = layout.items_per_shard if layout is not None \
+                else None
+            mass = layout.counts_per_shard if layout is not None \
+                else None
+            cap = int(Y.shape[0]) // n_sh
+            shards_out = []
+            for s in range(n_sh):
+                ent = {
+                    "shard": s,
+                    "factorBytes": int(per_shard(X) + per_shard(Y)
+                                       + per_shard(Yn)),
+                    "items": int(items[s]) if items is not None
+                    else max(0, min(self.n_items - s * cap, cap)),
+                }
+                if mass is not None:
+                    ent["interactions"] = int(mass[s])
+                shards_out.append(ent)
+            report["shardAxis"] = axis
+            report["nShards"] = n_sh
+            report["shards"] = shards_out
+            if layout is not None:
+                report["shardBalance"] = layout.balance_report()
+        return report
 
     def ladder_report(self) -> Dict[str, Any]:
         """AOT bucket-ladder coverage and footprint: the last warmup's
@@ -1953,7 +2414,10 @@ class DeviceTopK:
         NOT cached: pinning a fp32 copy next to the int8 store would
         cost more HBM than serving fp32 outright (the catalog-capacity
         win is the whole point); fold-in reads this once per fold
-        cadence, so the dequant is a transient elementwise program."""
+        cadence, so the dequant is a transient elementwise program.
+        The same tradeoff covers the density layout's id-order gather
+        below — caching it would pin a second full item table in HBM
+        to save one transient take per fold."""
         from predictionio_tpu.ops.quantize import (
             dequantize_rows,
             is_quantized,
@@ -1961,7 +2425,15 @@ class DeviceTopK:
 
         with self._store_lock:
             Y = self._Y
-        return dequantize_rows(Y) if is_quantized(Y) else Y
+            inv = self._inv_np
+        Yf = dequantize_rows(Y) if is_quantized(Y) else Y
+        if inv is not None:
+            # density-sharded store: hand back ITEM-id order (the
+            # fold-in solve indexes by item id, not store position)
+            import jax.numpy as jnp
+
+            Yf = jnp.take(Yf, jnp.asarray(inv), axis=0)
+        return Yf
 
     @property
     def user_capacity(self) -> int:
@@ -1969,14 +2441,23 @@ class DeviceTopK:
         return int(self._X.shape[0])
 
     @property
+    def shard_count(self) -> int:
+        """Mesh shards the factor store spans (1 = single store)."""
+        return 1 if self._shard is None else int(self._shard[2])
+
+    @property
+    def item_layout(self):
+        """The density-aware :class:`~predictionio_tpu.parallel.
+        als_sharding.ItemShardLayout` serving this store, or None."""
+        return self._layout
+
+    @property
     def growable(self) -> bool:
-        """Whether :meth:`patch_users` can grow the user store. False
-        for mesh-sharded stores — those grow at retrain only, so a
-        fold-in deployment must refuse them up front rather than poison
-        every fold batch with the first unknown user."""
-        sh = getattr(self._X, "sharding", None)
-        return not (sh is not None and getattr(
-            getattr(sh, "mesh", None), "devices", np.empty(1)).size > 1)
+        """Whether :meth:`patch_users` can grow the user store. Always
+        true since ISSUE 15: mesh-sharded stores grow by RESHARDING
+        (a padded re-placement over the same mesh) instead of refusing,
+        so fold-in runs against sharded deployments too."""
+        return True
 
     def patch_users(self, uids, factors,
                     seen_items: Optional[Dict[int, np.ndarray]] = None
@@ -2001,8 +2482,10 @@ class DeviceTopK:
         concurrent query sees either the whole old store or the whole
         new one — never a torn mix. On accelerators the scatter donates
         the old buffer (in-place HBM update, the PR-5 donation
-        discipline); growth, when a sharded store would need it, is
-        refused loudly — sharded models grow at retrain time.
+        discipline); growth on a MESH-SHARDED store reshards — the
+        larger row-sharded buffers are allocated in the same placement
+        and the old rows copied in (no more refusal; sharded fold-in
+        deployments grow like single-chip ones).
         """
         import jax.numpy as jnp
 
@@ -2016,6 +2499,8 @@ class DeviceTopK:
             return
         if uids.min() < 0:
             raise ValueError("patch_users: negative user index")
+        seen_items = self._translate_seen(seen_items) if seen_items \
+            else seen_items
         with self._store_lock:
             sig_before = self._store_sig_locked()
             # phase 1 — everything that can FAIL, with no live buffer
@@ -2035,13 +2520,16 @@ class DeviceTopK:
             needed = int(uids.max()) + 1
             cap = X.shape[0]
             if needed > cap:
-                if not self.growable:
-                    raise ValueError(
-                        "patch_users: cannot grow a mesh-sharded factor "
-                        "store in place; unknown users on sharded models "
-                        "need a retrain")
                 new_cap = _bucket(needed, lo=max(cap, 16))
-                if is_quantized(X):
+                if self._shard is not None:
+                    # growth reshards: round capacity to the shard
+                    # divisor and run a pad program pinned to the
+                    # store's own row sharding (new rows zero / scale
+                    # 1 until patched)
+                    n_sh = int(self._shard[2])
+                    new_cap = -(-new_cap // n_sh) * n_sh
+                    X = self._grow_rows_sharded(X, new_cap)
+                elif is_quantized(X):
                     # grown rows: zero data with scale 1 (dequant = 0)
                     X = QuantFactors(
                         jnp.concatenate(
@@ -2097,6 +2585,39 @@ class DeviceTopK:
                 # warmup()/precompile() re-ladders the new shape
                 self._aot_programs.clear()
 
+    def _grow_rows_sharded(self, X, new_cap: int):
+        """Grow a mesh-sharded user store to ``new_cap`` rows by
+        RESHARDING: a pad program whose output is pinned to the store's
+        row sharding, so the new buffers land distributed and the old
+        rows copy over ICI-local lanes. Returns the grown store (the
+        caller publishes it under ``_store_lock``)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from predictionio_tpu.ops.quantize import (
+            QuantFactors,
+            is_quantized,
+        )
+
+        mesh, axis, _ = self._shard
+        row = NamedSharding(mesh, P(axis, None))
+        col = NamedSharding(mesh, P(axis))
+
+        def grow(a, sharding, fill):
+            pad = new_cap - int(a.shape[0])
+            fn = jax.jit(
+                lambda x: jnp.pad(
+                    x, ((0, pad),) + ((0, 0),) * (x.ndim - 1),
+                    constant_values=fill),
+                out_shardings=sharding)
+            return fn(a)
+
+        if is_quantized(X):
+            return QuantFactors(grow(X.data, row, 0),
+                                grow(X.scale, col, 1.0))
+        return grow(X, row, 0.0)
+
     def _prep_seen_locked(self, seen_items: Dict[int, np.ndarray],
                           n_rows: int):
         """Seen tables grown (rows and row length, same bucket ladder as
@@ -2111,14 +2632,22 @@ class DeviceTopK:
         L = int(cols.shape[1])
         longest = max((len(v) for v in seen_items.values()), default=0)
         new_L = _bucket(max(longest, 1), lo=L)
+        grown = False
         if new_L > L:
             pad = new_L - L
             cols = jnp.pad(cols, ((0, 0), (0, pad)))
             mask = jnp.pad(mask, ((0, 0), (0, pad)))
+            grown = True
         rows = int(cols.shape[0])
         if n_rows > rows:
             cols = jnp.pad(cols, ((0, n_rows - rows), (0, 0)))
             mask = jnp.pad(mask, ((0, n_rows - rows), (0, 0)))
+            grown = True
+        if grown:
+            # grown tables must keep the mesh-replicated placement the
+            # compiled programs (and AOT executables) expect
+            cols = self._replicate_like_factors(cols)
+            mask = self._replicate_like_factors(mask)
         sids = np.fromiter(seen_items.keys(), dtype=np.int64,
                            count=len(seen_items))
         row_c = np.zeros((len(sids), new_L), dtype=np.int32)

@@ -9,6 +9,9 @@ is needed — collectives are compiled into the program.
 
 from predictionio_tpu.parallel.mesh import data_parallel_mesh, mesh_2d
 from predictionio_tpu.parallel.als_sharding import (
+    ItemShardLayout,
+    contiguous_item_layout,
+    density_aware_item_layout,
     train_als_sharded,
     train_als_sharded_2d,
 )
@@ -24,4 +27,6 @@ from predictionio_tpu.ops.attention import (  # sequence parallel
 
 __all__ = ["data_parallel_mesh", "mesh_2d", "train_als_sharded",
            "train_als_sharded_2d", "ring_attention", "ulysses_attention",
-           "distributed", "DistributedConfig", "host_aware_mesh"]
+           "distributed", "DistributedConfig", "host_aware_mesh",
+           "ItemShardLayout", "density_aware_item_layout",
+           "contiguous_item_layout"]

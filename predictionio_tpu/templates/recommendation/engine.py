@@ -722,6 +722,10 @@ class ShardedALSModel(_DeviceServedModel):
     item_map: StringIndexBiMap
     seen: Dict[int, np.ndarray]
     item_categories: Optional[Dict[int, Tuple[str, ...]]] = None
+    # density-aware shard layout (parallel.als_sharding.ItemShardLayout)
+    # carried WITH the model so serving, fold-in, and eval all see one
+    # consistent item placement; None serves the training placement
+    item_layout: Any = None
     _server: Any = dataclasses.field(default=None, repr=False, compare=False)
 
     def _make_server(self):
@@ -729,7 +733,8 @@ class ShardedALSModel(_DeviceServedModel):
 
         return DeviceTopK(
             self.user_factors, self.item_factors, self.seen,
-            n_users=self.n_users, n_items=self.n_items)
+            n_users=self.n_users, n_items=self.n_items,
+            item_layout=self.item_layout)
 
     def sanity_check(self) -> None:
         # finiteness check WITHOUT gathering the factors: reduce on device
@@ -754,17 +759,32 @@ class ALSShardedAlgorithm(_DeviceServingAlgo, PAlgorithm):
 
     def train(self, ctx: ComputeContext,
               pd: PreparedData) -> ShardedALSModel:
-        from predictionio_tpu.parallel.als_sharding import train_als_device
+        import jax
+
+        from predictionio_tpu.ops.als import item_interaction_counts
+        from predictionio_tpu.parallel.als_sharding import (
+            density_aware_item_layout,
+            train_als_device,
+        )
         from predictionio_tpu.workflow.checkpoint import (
             bimap_fingerprint_scope)
 
         with bimap_fingerprint_scope(pd.user_map, pd.item_map):
             X, Y = train_als_device(pd.user_side, pd.item_side,
                                     self.params)
+        # serving layout: on a multi-device runtime the item store
+        # re-places density-aware (greedy bin-pack over the power-law
+        # head, ISSUE 15) so no serve shard hot-spots; the layout
+        # travels inside the model so fold-in/eval read one placement
+        layout = None
+        n_dev = len(jax.devices())
+        if n_dev > 1:
+            layout = density_aware_item_layout(
+                item_interaction_counts(pd.item_side), n_dev)
         return ShardedALSModel(
             X, Y, pd.user_side.n_rows, pd.user_side.n_cols,
             pd.user_map, pd.item_map, pd.seen,
-            item_categories=pd.item_categories)
+            item_categories=pd.item_categories, item_layout=layout)
 
     def batch_predict(self, ctx: ComputeContext, model: ShardedALSModel,
                       indexed_queries) -> List[Tuple[int, Any]]:
@@ -832,6 +852,30 @@ class PrecisionAtK(OptionAverageMetric):
         if not top:
             return 0.0
         return sum(1 for i in top if i in actual) / float(self.k)
+
+
+class NDCGAtK(OptionAverageMetric):
+    """NDCG@k on top-N recommendations — the sequence-aware companion
+    to :class:`PrecisionAtK` (ROADMAP item-1 follow-on): rank position
+    matters, so a model that puts a held-out item first scores higher
+    than one that buries it at position k. Shares the binary-relevance
+    math with the bench (``data.sliding.ndcg_at_k``)."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"NDCG@{self.k}"
+
+    def calculate_qpa(self, q: Query, p: PredictedResult,
+                      a: ActualResult) -> Optional[float]:
+        if not a.items:
+            return None
+        from predictionio_tpu.data.sliding import ndcg_at_k
+
+        return ndcg_at_k([s.item for s in p.item_scores], a.items,
+                         self.k)
 
 
 class RecommendationParamsList(EngineParamsGenerator):

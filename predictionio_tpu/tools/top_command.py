@@ -119,6 +119,15 @@ def render(stats: Dict[str, Any], dispatches: Dict[str, Any],
             f"(+{cov.get('warmed', 0)} warmed) · "
             f"hit {req.get('hit', 0)} / missJit {req.get('missJit', 0)} · "
             f"evicted {((ladder.get('cache') or {}).get('evictions', 0))}")
+        # mesh-sharded store: one line per shard so a hot shard (HBM
+        # or interaction mass) is visible at a glance
+        for sh in store.get("shards") or []:
+            mass = sh.get("interactions")
+            lines.append(
+                f"shard    #{sh.get('shard', '?'):<3} "
+                f"{_fmt_bytes(sh.get('factorBytes'))} · "
+                f"{sh.get('items', 0)} items"
+                + ("" if mass is None else f" · {mass} interactions"))
     summary = (dispatches or {}).get("summary") or {}
     for lane, s in sorted(summary.items()):
         lines.append(

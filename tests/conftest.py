@@ -26,6 +26,31 @@ from predictionio_tpu.data import storage  # noqa: E402
 from predictionio_tpu.data.storage import StorageConfig  # noqa: E402
 
 
+@pytest.fixture(scope="session")
+def multichip_devices():
+    """The virtual multi-device plane the ``multichip``-marked sharded
+    differentials run on: conftest forced 8 host-platform CPU devices
+    before the first jax import (the local-mode SparkContext analog),
+    so tier-1 exercises real mesh collectives without hardware. Skips
+    — instead of silently degenerating to one shard — if an
+    environment override stripped the virtual devices."""
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip(f"multichip tests need >=4 devices, have {len(devs)} "
+                    "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    return devs
+
+
+@pytest.fixture
+def multichip_mesh(multichip_devices):
+    """A 4-way 1-D 'data' mesh over the virtual device plane — the
+    shape the sharded-serving differentials and the ISSUE-15 sharded
+    fold-in tests run against."""
+    from predictionio_tpu.parallel.mesh import data_parallel_mesh
+
+    return data_parallel_mesh(4, devices=multichip_devices)
+
+
 @pytest.fixture
 def mem_storage():
     """Process-global registry backed by fresh in-memory DAOs."""

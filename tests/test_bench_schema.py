@@ -1,0 +1,73 @@
+"""Bench artifact schema gate (ISSUE 15 satellite): every lane in a
+``bench.py`` artifact must carry its PR-11 ``device`` stamp and the
+headline its ``accelerator`` flag — checked by
+``bench.artifact_schema_problems``, which ``main`` asserts on, so the
+staleness self-description can't silently regress when a new lane
+(sharded serving, scale_1b, ...) is added."""
+
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+import bench  # noqa: E402
+
+
+class TestArtifactSchema:
+    def _artifact(self):
+        detail = {
+            "serving_load": {"p50_ms": 1.0},
+            "scale_1b": {"shards": 4},
+            "scale_100m": None,          # skipped lanes stay None
+            "nested_scalar": 3,          # non-dict values are exempt
+        }
+        for lane in detail.values():
+            bench._stamp_device(lane)
+        return {"metric": bench.HEADLINE_METRIC, "value": 1,
+                "accelerator": False, "detail": detail}
+
+    def test_stamped_artifact_conforms(self):
+        assert bench.artifact_schema_problems(self._artifact()) == []
+
+    def test_missing_device_stamp_is_caught(self):
+        art = self._artifact()
+        del art["detail"]["scale_1b"]["device"]
+        problems = bench.artifact_schema_problems(art)
+        assert any("scale_1b" in p for p in problems)
+
+    def test_missing_accelerator_flag_is_caught(self):
+        art = self._artifact()
+        del art["accelerator"]
+        problems = bench.artifact_schema_problems(art)
+        assert any("accelerator" in p for p in problems)
+
+    def test_new_unstamped_lane_is_caught(self):
+        art = self._artifact()
+        art["detail"]["future_lane"] = {"qps": 9}
+        problems = bench.artifact_schema_problems(art)
+        assert any("future_lane" in p for p in problems)
+
+    def test_stamp_device_fills_and_preserves(self):
+        stamped = bench._stamp_device({"device": "tpu"})
+        assert stamped["device"] == "tpu"     # existing stamp kept
+        fresh = bench._stamp_device({})
+        assert fresh["device"]                # filled from the backend
+        assert bench._stamp_device(None) is None
+
+
+class TestScale1bLaneWiring:
+    @pytest.mark.multichip
+    def test_scale_1b_smoke_end_to_end(self):
+        """The CPU-sized scale_1b shape runs end to end and stamps
+        shard count + device (the acceptance wiring check `main`
+        runs in --smoke)."""
+        r = bench.scale_1b_bench(n_users=300, n_items=80, nnz=20_000,
+                                 rank=8, iterations=1,
+                                 block_size=5_000, topk_queries=4)
+        assert r["device"]
+        assert r["shards"] >= 1
+        assert r["zero_compile_steady_state"] is True
+        assert r["shard_balance"]["nShards"] == r["shards"]
+        assert np.isfinite(r["ingest_events_per_sec"])

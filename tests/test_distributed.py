@@ -74,6 +74,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.multichip
 def test_two_process_training_matches_single(tmp_path):
     """Launch 2 real host processes (2 virtual CPU devices each) through
     jax.distributed; the 4-device global-mesh training must match the

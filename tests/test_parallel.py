@@ -9,6 +9,10 @@ from predictionio_tpu.ops.als import ALSParams, pad_ratings, train_als
 from predictionio_tpu.parallel import data_parallel_mesh, train_als_sharded
 from tests.test_als import synthetic_ratings
 
+# multichip: rerunnable on a REAL mesh via `pytest -m multichip` on the
+# bench host; tier-1 runs them on the virtual 8-device plane
+pytestmark = pytest.mark.multichip
+
 
 @pytest.fixture(scope="module")
 def mesh8():

@@ -9,8 +9,55 @@ import pytest
 from predictionio_tpu.data.sliding import (
     group_by_entity,
     leave_last_out,
+    ndcg_at_k,
     sliding_window_masks,
 )
+
+
+class TestNDCGAtK:
+    def test_perfect_ranking_is_one(self):
+        assert ndcg_at_k(["a", "b", "c"], {"a", "b", "c"}, 3) == \
+            pytest.approx(1.0)
+
+    def test_rank_position_matters(self):
+        """The sequence-aware property Precision@k lacks: the same hit
+        scores MORE at rank 1 than buried at rank k."""
+        first = ndcg_at_k(["hit", "x", "y"], {"hit"}, 3)
+        last = ndcg_at_k(["x", "y", "hit"], {"hit"}, 3)
+        assert first == pytest.approx(1.0)
+        assert 0 < last < first
+
+    def test_known_value(self):
+        # one hit at rank 2 of k=3, one relevant: dcg=1/log2(3),
+        # ideal=1/log2(2)=1
+        got = ndcg_at_k(["x", "hit", "y"], {"hit"}, 3)
+        assert got == pytest.approx(1.0 / np.log2(3.0))
+
+    def test_miss_is_zero_and_empty_relevant_is_zero(self):
+        assert ndcg_at_k(["x", "y"], {"z"}, 2) == 0.0
+        assert ndcg_at_k(["x", "y"], set(), 2) == 0.0
+
+    def test_ideal_clips_to_k(self):
+        # 2 relevant but k=1: placing one on top is ideal
+        assert ndcg_at_k(["a"], {"a", "b"}, 1) == pytest.approx(1.0)
+
+    def test_template_metric_uses_helper(self):
+        from predictionio_tpu.templates.recommendation.engine import (
+            ActualResult,
+            ItemScore,
+            NDCGAtK,
+            PredictedResult,
+            Query,
+        )
+
+        m = NDCGAtK(k=2)
+        p = PredictedResult((ItemScore("i1", 2.0), ItemScore("i2", 1.0)))
+        assert m.calculate_qpa(Query(user="u"), p,
+                               ActualResult(["i2"])) == \
+            pytest.approx(1.0 / np.log2(3.0))
+        assert m.calculate_qpa(Query(user="u"), p,
+                               ActualResult([])) is None
+        assert m.header == "NDCG@2"
 
 
 class TestSlidingWindowMasks:
