@@ -701,13 +701,118 @@ def scale_1b_bench(n_users: int = 2_000_000, n_items: int = 200_000,
     return result
 
 
+def tuning_grid_bench(n_users: int = N_USERS, n_items: int = N_ITEMS,
+                      nnz: int = NNZ, iterations: int = ITERATIONS,
+                      grid_size: int = 8, rank: int = 16,
+                      topk: int = 10, seed: int = 7) -> dict:
+    """Vmapped multi-config training (ISSUE 16): one device program
+    advances the whole hyperparameter grid per iteration, against ONE
+    resident copy of the bucketed tables. Serial lane = k independent
+    ``train_als_bucketed`` runs, which is also the honest reference
+    story: lambda/alpha are STATIC jit args there, so k distinct
+    configs pay k XLA compiles on top of k trainings. Vmapped lane =
+    grid-aware AOT warm-up (compile hidden in the ingest window, as in
+    production) + the steady-state grid train under the zero-compile
+    gate. The per-config leaderboard (device top-k eval) is embedded in
+    the artifact and schema-gated by ``artifact_schema_problems``."""
+    import bench_quality
+    from predictionio_tpu.ops import tuning as ops_tuning
+    from predictionio_tpu.ops.als import (
+        ALSParams,
+        bucket_ratings_pair,
+        train_als_bucketed,
+        warmup_train_als_bucketed,
+    )
+    from predictionio_tpu.utils import metrics
+    from predictionio_tpu.workflow import tuning as wf_tuning
+
+    tr, tc, tv, held = bench_quality.build_split(n_users, n_items, nnz,
+                                                 seed)
+    user_side, item_side = bucket_ratings_pair(tr, tc, tv, n_users,
+                                               n_items)
+    user_side, item_side = user_side.to_device(), item_side.to_device()
+
+    base = ALSParams(rank=rank, num_iterations=iterations,
+                     lambda_=LAMBDA, alpha=ALPHA, seed=seed)
+    lambdas = np.geomspace(0.003, 3.0, grid_size)
+    grid = ops_tuning.make_grid(
+        base, [{"lambda": float(l)} for l in lambdas])
+
+    # serial lane: one full train per config (fresh compile each — the
+    # static-lambda contract)
+    t0 = time.perf_counter()
+    serial = [train_als_bucketed(user_side, item_side, c)
+              for c in grid.configs]
+    serial_sec = time.perf_counter() - t0
+
+    # vmapped lane: AOT warm-up, one absorb run (first dispatch + the
+    # finite-guard jit), then the steady-state timed train under the
+    # zero-compile gate
+    metrics.install_jit_compile_listener()
+    t0 = time.perf_counter()
+    warmed = warmup_train_als_bucketed(user_side, item_side, grid)
+    warmup_sec = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    result = ops_tuning.train_als_grid_bucketed(user_side, item_side,
+                                                grid)
+    first_sec = time.perf_counter() - t0
+    compiles0 = metrics.JIT_COMPILES.value()
+    t0 = time.perf_counter()
+    result = ops_tuning.train_als_grid_bucketed(user_side, item_side,
+                                                grid)
+    vmapped_sec = time.perf_counter() - t0
+    jit_delta = metrics.JIT_COMPILES.value() - compiles0
+
+    # differential stamp vs the serial factors (reduction-order drift
+    # only; the suite gates this at near-machine tolerance)
+    max_diff = max(
+        max(float(np.abs(Xs - result.factors_for(i)[0]).max()),
+            float(np.abs(Ys - result.factors_for(i)[1]).max()))
+        for i, (Xs, Ys) in enumerate(serial))
+
+    board = ops_tuning.grid_leaderboard(result, tr, tc, held, topk=topk)
+    hbm = wf_tuning.hbm_budget_bytes()
+    per_cfg = wf_tuning.grid_bytes_per_config(n_users, n_items, grid,
+                                              user_side, item_side)
+    speedup = serial_sec / vmapped_sec if vmapped_sec > 0 else None
+    return _stamp_device({
+        "grid_size": grid.k,
+        "rank": rank, "iterations": iterations,
+        "n_users": n_users, "n_items": n_items, "events": int(nnz),
+        "lambdas": [round(float(l), 5) for l in lambdas],
+        "serial_total_sec": round(serial_sec, 2),
+        "vmapped_warmup_sec": round(warmup_sec, 2),
+        "vmapped_first_sec": round(first_sec, 2),
+        "vmapped_total_sec": round(vmapped_sec, 2),
+        "speedup_vs_serial": round(speedup, 2),
+        "speedup_gate_pass": bool(speedup >= 5.0),
+        "aot_warmed": bool(warmed),
+        "jit_compiles_steady_state": int(jit_delta),
+        "zero_compile_steady_state": jit_delta == 0,
+        "max_abs_diff_vs_serial": float(max_diff),
+        "diverged_configs": int((~result.alive).sum()),
+        "hbm_budget_bytes": hbm,
+        "bytes_per_config": int(per_cfg),
+        "leaderboard": board["rows"],
+        "winner": board["winner"],
+        "metric_name": board["metricName"],
+        "note": ("serial = k train_als_bucketed runs (k compiles: "
+                 "lambda is a static jit arg there); vmapped = one "
+                 "AOT-warmed program advancing all k configs per "
+                 "iteration against ONE resident table copy, timed at "
+                 "steady state under the zero-compile gate"),
+    })
+
+
 def artifact_schema_problems(artifact: dict) -> list:
     """Validate the bench artifact's staleness self-description (the
     PR-11 contract, now a checkable schema): the headline must carry
     ``accelerator`` and every dict-valued lane under ``detail`` must
     carry its per-lane ``device`` stamp — new lanes included, so the
-    self-description can't silently regress. Returns problem strings
-    (empty = conformant)."""
+    self-description can't silently regress. Lanes embedding a tuning
+    ``leaderboard`` (ISSUE 16) must also carry well-formed per-config
+    rows and a ``winner``, so the grid-eval artifact schema can't rot
+    either. Returns problem strings (empty = conformant)."""
     problems = []
     if "accelerator" not in artifact:
         problems.append("headline missing 'accelerator'")
@@ -718,6 +823,44 @@ def artifact_schema_problems(artifact: dict) -> list:
     for name, lane in detail.items():
         if isinstance(lane, dict) and "device" not in lane:
             problems.append(f"lane {name!r} missing 'device' stamp")
+        if isinstance(lane, dict) and "leaderboard" in lane:
+            problems.extend(_leaderboard_schema_problems(name, lane))
+    return problems
+
+
+def _leaderboard_schema_problems(name: str, lane: dict) -> list:
+    """Per-config leaderboard schema: every row names its config, its
+    sweep params and its diverged flag, live rows carry a numeric
+    metric, and the lane pins a winner (None only if every config
+    diverged)."""
+    problems = []
+    rows = lane.get("leaderboard")
+    if not isinstance(rows, list) or not rows:
+        problems.append(
+            f"lane {name!r}: 'leaderboard' must be a non-empty list")
+        return problems
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            problems.append(
+                f"lane {name!r} leaderboard[{i}]: not an object")
+            continue
+        for key in ("config", "params", "diverged"):
+            if key not in row:
+                problems.append(
+                    f"lane {name!r} leaderboard[{i}] missing {key!r}")
+        if not row.get("diverged") and \
+                not isinstance(row.get("metric"), (int, float)):
+            problems.append(
+                f"lane {name!r} leaderboard[{i}]: live config must "
+                f"carry a numeric 'metric'")
+    if "winner" not in lane:
+        problems.append(
+            f"lane {name!r}: leaderboard without a 'winner' entry")
+    elif lane["winner"] is None and \
+            not all(r.get("diverged") for r in rows
+                    if isinstance(r, dict)):
+        problems.append(
+            f"lane {name!r}: winner is None but live configs exist")
     return problems
 
 
@@ -2577,6 +2720,14 @@ def main(smoke: bool = False) -> None:
             "iterations": 16, "checkpoint_every": 8,
             "repeats": 4} if smoke else {}))
 
+    # vmapped multi-config training (ISSUE 16): one device program
+    # advances the whole 8-config grid vs 8 serial trains (which also
+    # pay 8 compiles — lambda is static in the serial jit). Leaderboard
+    # embedded; >=5x gate; zero-compile steady state asserted
+    tuning_grid = tuning_grid_bench(
+        **({"n_users": 300, "n_items": 120, "nnz": 8000,
+            "iterations": 2, "rank": 8} if smoke else {}))
+
     # fp32 vs bf16 precision lanes on the headline shape (the fp32 lane
     # stays the headline definition; this reports what bf16 buys)
     precision = als_precision_bench(
@@ -2638,6 +2789,7 @@ def main(smoke: bool = False) -> None:
         "scale_100m": scale100,
         "scale_1b": scale1b,
         "train_resume": train_resume,
+        "tuning_grid": tuning_grid,
         "precision_lanes": precision,
         "quality": quality,
         "quality_scale_truncation": quality_scale,
@@ -2696,6 +2848,15 @@ def main(smoke: bool = False) -> None:
         "train_ckpt_overhead_frac": train_resume["overhead_frac"],
         "train_ckpt_overhead_gate": train_resume["overhead_gate_pass"],
         "train_resume_equal": train_resume["resumed_equal"],
+        "tuning_grid_speedup_vs_serial":
+            tuning_grid["speedup_vs_serial"],
+        "tuning_grid_speedup_gate":
+            tuning_grid["speedup_gate_pass"],
+        "tuning_grid_zero_compiles":
+            tuning_grid["zero_compile_steady_state"],
+        "tuning_grid_winner_metric":
+            None if tuning_grid["winner"] is None
+            else tuning_grid["winner"]["metric"],
         "bf16_epoch_speedup_vs_fp32":
             precision["bf16_speedup_vs_fp32"],
         "serving_batched_qps":

@@ -131,6 +131,19 @@ def params_to_dict(params: Params) -> Dict[str, Any]:
     return dict(getattr(params, "__dict__", {}))
 
 
+def expand_engine_params(base: EngineParams, algo_name: str,
+                         variants: Sequence[Params]
+                         ) -> List[EngineParams]:
+    """One full EngineParams per swept algorithm Params — the
+    reference's ``EngineParamsGenerator.engineParamsList`` built
+    mechanically from a base: every non-algorithm stage is shared,
+    only the named algorithm's params vary. The grid tuner
+    (``pio eval --grid``) uses this to pin each leaderboard row — and
+    the winner — to a complete, trainable parameterization."""
+    return [base.replace(algorithm_params_list=[(algo_name, p)])
+            for p in variants]
+
+
 def _stage_from_variant(variant: Mapping[str, Any], field: str,
                         class_map: Mapping[str, type]
                         ) -> Tuple[str, Params]:

@@ -581,7 +581,8 @@ def _refine_solve(A, b, X, solver: Optional[str]):
 
 def _solve_rows(Y, cols, weights, mask, lam: float, alpha: float,
                 implicit: bool, gram=None, solver: Optional[str] = None,
-                precision: str = "fp32", refine: bool = False):
+                precision: str = "fp32", refine: bool = False,
+                extra_ridge=None):
     """Normal-equation solve for one batch of rows: given fixed factors
     ``Y [M, R]`` and padded ratings ``[B, L]`` (+ validity mask), return
     new factors ``[B, R]``. ``gram`` (``Y^T Y``, implicit term) may be
@@ -590,6 +591,17 @@ def _solve_rows(Y, cols, weights, mask, lam: float, alpha: float,
     jit-friendly: static shapes, two einsums + batched Cholesky; runs on
     the MXU. Written to be shard_map-compatible: only ``cols``/``weights``/
     ``mask`` carry the batch dimension.
+
+    ``lam``/``alpha`` may be python floats (the serial paths, where they
+    are static jit args) or traced scalars (the vmapped config-grid
+    path, where one compiled program serves every hyperparameter
+    value). ``extra_ridge`` is an optional ``[R]`` diagonal addition the
+    grid path uses to keep rank-padded columns solvable: a config of
+    rank r < R carries zero factor columns beyond r, which zero the
+    corresponding rows/cols of A and of b, so with a positive ridge on
+    those diagonal entries the padded coordinates solve to EXACT zeros
+    (block-diagonal system, zero rhs) and the leading r coordinates are
+    untouched — even at lambda = 0.
 
     ``precision="bf16"``: ``Y`` is stored bfloat16, so the dominant
     ``[B, L, R]`` gather moves half the HBM bytes; the confidence
@@ -607,7 +619,7 @@ def _solve_rows(Y, cols, weights, mask, lam: float, alpha: float,
     Yg = jnp.take(Y, cols, axis=0)            # [B, L, R] gather
     if precision == "bf16":
         X = _solve_rows_bf16(Y, Yg, weights, mask, lam, alpha, implicit,
-                             gram, solver, refine)
+                             gram, solver, refine, extra_ridge)
         return zero_empty_rows(X, mask.astype(X.dtype))
     mask = mask.astype(Y.dtype)
     w = weights.astype(Y.dtype) * mask        # zero out padded slots
@@ -637,6 +649,9 @@ def _solve_rows(Y, cols, weights, mask, lam: float, alpha: float,
             * jnp.eye(R, dtype=Y.dtype)[None, :, :]
         b = jnp.einsum("bl,blr->br", w, Yg, precision=hi)
 
+    if extra_ridge is not None:
+        A += extra_ridge.astype(A.dtype)[None, None, :] \
+            * jnp.eye(R, dtype=A.dtype)
     X = _spd_solve(A, b, solver)
     if refine:
         X = _refine_solve(A, b, X, solver)
@@ -645,7 +660,7 @@ def _solve_rows(Y, cols, weights, mask, lam: float, alpha: float,
 
 def _solve_rows_bf16(Y, Yg, weights, mask, lam: float, alpha: float,
                      implicit: bool, gram, solver: Optional[str],
-                     refine: bool):
+                     refine: bool, extra_ridge=None):
     """The bf16 lane of :func:`_solve_rows`: bf16 operands into every
     MXU pass, fp32 accumulators out (``preferred_element_type``), fp32
     solve, result cast back to bf16 factor storage."""
@@ -673,6 +688,8 @@ def _solve_rows_bf16(Y, Yg, weights, mask, lam: float, alpha: float,
             * jnp.eye(R, dtype=f32)[None, :, :]
         b = jnp.einsum("bl,blr->br", w32.astype(bf16), Yg,
                        preferred_element_type=f32)
+    if extra_ridge is not None:
+        A += extra_ridge.astype(f32)[None, None, :] * jnp.eye(R, dtype=f32)
     X = _spd_solve(A, b, solver)
     if refine:
         X = _refine_solve(A, b, X, solver)
@@ -909,7 +926,8 @@ def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
                          alpha: float, implicit: bool,
                          slot_budget: Optional[int],
                          solver: Optional[str] = None,
-                         precision: str = "fp32", refine: bool = False):
+                         precision: str = "fp32", refine: bool = False,
+                         extra_ridge=None):
     """One alternating half-step over length buckets: each bucket is a
     batched solve at its own ``L`` (one Gram matrix shared by all), and
     the results scatter into the full factor matrix. Rows in no bucket
@@ -947,7 +965,8 @@ def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
             def one(args, _gram=gram):
                 c_, w_, m_ = args
                 return _solve_rows(Y, c_, w_, m_, lam, alpha, implicit,
-                                   _gram, solver, precision, refine)
+                                   _gram, solver, precision, refine,
+                                   extra_ridge)
 
             Xb = jax.lax.map(one, (cols.reshape(nb, block, L),
                                    w.reshape(nb, block, L),
@@ -955,7 +974,7 @@ def _solve_side_bucketed(Y, buckets, n_rows_out: int, lam: float,
             Xb = Xb.reshape(B + pad, R)
         else:
             Xb = _solve_rows(Y, cols, w, m, lam, alpha, implicit, gram,
-                             solver, precision, refine)
+                             solver, precision, refine, extra_ridge)
         # pad rows carry the sentinel row_id == n_rows_out -> dropped
         X = X.at[row_ids].set(Xb, mode="drop")
     return X
@@ -1048,6 +1067,138 @@ def _als_iterations_bucketed(*args, **kw):
         if compiled is not None:
             return compiled(*args)
     return jitted(*args, **kw)
+
+
+def _als_iterations_grid_impl(X, Y, lam, alpha, ridge, u_buckets,
+                              i_buckets, *, implicit, num_iterations,
+                              slot_budget, solver=None,
+                              precision="fp32", refine=False):
+    """Multi-config bucketed training loop: the per-iteration half-steps
+    vmapped over a leading CONFIG axis (DrJAX's map-over-leading-axis
+    idiom), so ONE compiled program advances all k hyperparameter
+    configs per iteration.
+
+    ``X [k, N, R]`` / ``Y [k, M, R]`` carry one factor set per config;
+    ``lam [k]`` / ``alpha [k]`` are TRACED fp32 vectors (in the serial
+    path they are static jit args — k distinct lambdas there mean k XLA
+    compiles; here one program serves any values at fixed k);
+    ``ridge [k, R]`` is ``1.0`` on each config's rank-padded columns
+    (see :func:`_solve_rows` — pads solve to exact zeros, so a rank-r
+    config's leading r columns match its serial rank-r run). The bucket
+    tables are closed over WITHOUT a config axis: vmap broadcasts them,
+    so the device holds k factor sets but only ONE copy of the ratings —
+    ingest and HBM for the tables are paid once for the whole grid.
+    """
+    import jax
+
+    n_u, n_i = X.shape[1], Y.shape[1]
+
+    def half_steps(Xk, Yk, lamk, alphak, ridgek):
+        Xk = _solve_side_bucketed(Yk, u_buckets, n_u, lamk, alphak,
+                                  implicit, slot_budget, solver,
+                                  precision, refine, ridgek)
+        Yk = _solve_side_bucketed(Xk, i_buckets, n_i, lamk, alphak,
+                                  implicit, slot_budget, solver,
+                                  precision, refine, ridgek)
+        return Xk, Yk
+
+    vstep = jax.vmap(half_steps, in_axes=(0, 0, 0, 0, 0))
+
+    def body(carry, _):
+        Xc, Yc = carry
+        Xc, Yc = vstep(Xc, Yc, lam, alpha, ridge)
+        return (Xc, Yc), None
+
+    (X, Y), _ = jax.lax.scan(body, (X, Y), None, length=num_iterations)
+    return X, Y
+
+
+_als_iterations_grid_jit = None
+
+_AOT_GRID_MAX = 8
+_aot_grid = _AOTCache(_AOT_GRID_MAX, name="train-grid")
+
+
+def _get_grid_jit():
+    global _als_iterations_grid_jit
+    if _als_iterations_grid_jit is None:
+        import jax
+
+        _als_iterations_grid_jit = jax.jit(
+            _als_iterations_grid_impl,
+            static_argnames=("implicit", "num_iterations", "slot_budget",
+                             "solver", "precision", "refine"),
+            donate_argnums=(0, 1))
+    return _als_iterations_grid_jit
+
+
+def _als_iterations_grid(*args, **kw):
+    """Jitted grid loop (X/Y donated, lam/alpha/ridge traced); a
+    matching AOT executable from the grid-aware
+    :func:`warmup_train_als_bucketed` is used when present — the same
+    zero-steady-state-compile contract as the serial bucketed lane."""
+    jitted = _get_grid_jit()
+    if len(_aot_grid):
+        compiled = _aot_grid.get(_bucketed_aot_key(args, kw))
+        if compiled is not None:
+            return compiled(*args)
+    return jitted(*args, **kw)
+
+
+def _grid_call_args(user_side: BucketedRatings,
+                    item_side: BucketedRatings, configs,
+                    precision: str, abstract: bool = False,
+                    num_iterations: Optional[int] = None):
+    """The exact (args, static kwargs) grid training passes to
+    :func:`_als_iterations_grid` — shared with the AOT warm-up so a
+    warmed grid signature is guaranteed to match the real call.
+    ``configs`` is the ConfigGrid's resolved ALSParams sequence; shared
+    statics (implicit/precision/iterations/...) come from ``configs[0]``
+    (the ConfigGrid constructor enforces they are uniform)."""
+    import jax
+    import jax.numpy as jnp
+
+    base = configs[0]
+    k = len(configs)
+    r_max = max(int(c.rank) for c in configs)
+    lam = np.asarray([float(c.lambda_) for c in configs], np.float32)
+    alpha = np.asarray([float(c.alpha) for c in configs], np.float32)
+    # 1.0 exactly on rank-padded columns, 0.0 on real ones
+    ridge = (np.arange(r_max)[None, :]
+             >= np.asarray([int(c.rank) for c in configs])[:, None]
+             ).astype(np.float32)
+
+    def leaf(a):
+        return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) \
+            if abstract else a
+
+    as_tuples = lambda s: tuple(  # noqa: E731
+        (leaf(b.row_ids), leaf(b.cols), leaf(b.weights), leaf(b.mask))
+        for b in s.buckets)
+    if abstract:
+        dt = factor_dtype(precision)
+        X = jax.ShapeDtypeStruct((k, user_side.n_rows, r_max), dt)
+        Y = jax.ShapeDtypeStruct((k, item_side.n_rows, r_max), dt)
+        f32 = np.dtype(np.float32)
+        lam = jax.ShapeDtypeStruct((k,), f32)
+        alpha = jax.ShapeDtypeStruct((k,), f32)
+        ridge = jax.ShapeDtypeStruct((k, r_max), f32)
+    else:
+        X = Y = None  # caller inits real factors
+        lam, alpha = jnp.asarray(lam), jnp.asarray(alpha)
+        ridge = jnp.asarray(ridge)
+    args = (X, Y, lam, alpha, ridge,
+            as_tuples(user_side), as_tuples(item_side))
+    kw = dict(
+        implicit=bool(base.implicit_prefs),
+        num_iterations=int(base.num_iterations
+                           if num_iterations is None
+                           else num_iterations),
+        slot_budget=None if not base.bucket_slot_budget
+        else int(base.bucket_slot_budget),
+        solver=_spd_solver_mode(), precision=precision,
+        refine=bool(base.solve_refine))
+    return args, kw
 
 
 def checkpoint_layout_uniform(user_side: PaddedRatings,
@@ -1154,16 +1305,40 @@ def _bucketed_call_args(user_side: BucketedRatings,
 
 def warmup_train_als_bucketed(user_side: BucketedRatings,
                               item_side: BucketedRatings,
-                              params: ALSParams) -> bool:
+                              params) -> bool:
     """AOT-compile the bucketed training program for these exact bucket
     shapes/statics so the next :func:`train_als_bucketed` call starts
     computing immediately instead of paying its jit wait. The pipelined
     ingest runs this on a background thread WHILE the bucket tables'
     H2D transfers stream — compile time hides inside the transfer
     window. Best-effort: returns False (and the normal jit path compiles
-    as before) if this jax version's AOT path declines."""
+    as before) if this jax version's AOT path declines.
+
+    ``params`` may also be an :class:`~predictionio_tpu.ops.tuning.
+    ConfigGrid` — then the VMAPPED multi-config signature is lowered
+    instead, so grid training (``train_als_grid_bucketed``) keeps the
+    same zero-steady-state-compile contract as serial training."""
+    configs = getattr(params, "configs", None)
     try:
         from predictionio_tpu.ops import aot
+
+        if configs is not None:
+            base = configs[0]
+            precision = _als_precision_mode(base)
+            ok = True
+            for n in _checkpoint_chunk_lengths(base):
+                args, kw = _grid_call_args(user_side, item_side, configs,
+                                           precision, abstract=True,
+                                           num_iterations=n)
+                key = _bucketed_aot_key(args, kw)
+                if key in _aot_grid:
+                    continue
+                compiled = aot.lower_compile(_get_grid_jit(), *args, **kw)
+                if compiled is None:
+                    ok = False
+                    continue
+                _aot_grid.put(key, compiled)
+            return ok
 
         precision = _als_precision_mode(params)
         # with checkpointing active the chunked loop dispatches

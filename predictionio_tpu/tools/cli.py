@@ -246,10 +246,25 @@ def build_parser() -> argparse.ArgumentParser:
     train.set_defaults(func=run_commands.cmd_train)
 
     ev = sub.add_parser("eval", help="run an evaluation / tuning sweep")
-    ev.add_argument("evaluation", help="module:callable -> Evaluation")
+    ev.add_argument("evaluation", nargs="?", default=None,
+                    help="module:callable -> Evaluation (omit with --grid)")
     ev.add_argument("engine_params_generator", nargs="?", default=None,
                     help="module:callable -> EngineParamsGenerator")
     ev.add_argument("--batch", default="")
+    ev.add_argument("--grid", default=None, metavar="GRID_JSON",
+                    help="hyperparameter grid file ({base, configs, "
+                         "data}): every ALSParams config trains in ONE "
+                         "vmapped device program against shared "
+                         "bucketed tables (sweepable: rank, lambda, "
+                         "alpha; sized to the HBM budget, diverged "
+                         "configs masked out) and a leaderboard "
+                         "artifact is written with the winner's full "
+                         "engine params")
+    ev.add_argument("--grid-out", default="leaderboard.json",
+                    help="leaderboard artifact path (with --grid)")
+    ev.add_argument("--topk", type=int, default=10,
+                    help="leaderboard metric cutoff (precision@k / "
+                         "ndcg@k, with --grid)")
     ev.set_defaults(func=run_commands.cmd_eval)
 
     dep = sub.add_parser("deploy", help="serve a trained engine instance")

@@ -212,9 +212,149 @@ def cmd_train(args) -> int:
     return 0
 
 
+def _cmd_eval_grid(args) -> int:
+    """``pio eval --grid grid.json``: the vmapped tuning lane. The grid
+    file's ALSParams configs are validated LOUDLY (every unknown or
+    non-sweepable field named, before any device work), the app's rate
+    events are read once and leave-last-out split, and ONE device
+    program trains every config against the shared bucketed tables —
+    sized to the HBM budget, diverged configs masked out. Writes the
+    leaderboard artifact (metric per config; winner pinned with its
+    full EngineParams) to ``--grid-out``."""
+    import numpy as np
+
+    from predictionio_tpu.ops import als as _als
+    from predictionio_tpu.ops import tuning as ops_tuning
+    from predictionio_tpu.workflow import tuning as wf_tuning
+
+    try:
+        with open(args.grid, "r", encoding="utf-8") as f:
+            spec = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"[ERROR] cannot read grid file {args.grid}: {e}",
+              file=sys.stderr)
+        return 1
+    if not isinstance(spec, dict):
+        print(f"[ERROR] {args.grid}: grid file must be a JSON object",
+              file=sys.stderr)
+        return 1
+    unknown = sorted(set(spec) - {"base", "configs", "data"})
+    if unknown:
+        for key in unknown:
+            print(f"[ERROR] {args.grid}: unknown section {key!r} "
+                  "(expected: base, configs, data)", file=sys.stderr)
+        return 1
+    try:
+        grid = ops_tuning.grid_from_spec(
+            {k: spec[k] for k in ("base", "configs") if k in spec})
+    except ops_tuning.GridConfigError as e:
+        # the per-field loudness contract: one [ERROR] line per problem
+        for line in str(e).splitlines():
+            print(f"[ERROR] {args.grid}: {line.strip()}",
+                  file=sys.stderr)
+        return 1
+    data_spec = spec.get("data") or {}
+    app_name = data_spec.get("appName") or data_spec.get("app_name")
+    if not app_name:
+        print(f"[ERROR] {args.grid}: missing data.appName (the event "
+              "app to tune against)", file=sys.stderr)
+        return 1
+    event_names = list(data_spec.get("eventNames", ["rate"]))
+
+    from predictionio_tpu.data.store import PEventStore
+
+    try:
+        batch = PEventStore.find_columnar(
+            app_name=app_name,
+            channel_name=data_spec.get("channelName"),
+            entity_type="user", event_names=event_names,
+            target_entity_type="item", value_property="rating",
+            default_value=1.0)
+    except Exception as e:
+        print(f"[ERROR] cannot read events for app {app_name!r}: {e}",
+              file=sys.stderr)
+        return 1
+    if len(batch.entity_ids) == 0:
+        print(f"[ERROR] app {app_name!r} has no "
+              f"{'/'.join(event_names)} events to tune on",
+              file=sys.stderr)
+        return 1
+    users, rows = np.unique(np.asarray(batch.entity_ids),
+                            return_inverse=True)
+    items, cols = np.unique(np.asarray(batch.target_ids),
+                            return_inverse=True)
+    vals = np.asarray(batch.values, dtype=np.float32)
+
+    # leave-last-out holdout in stream order (the sliding-eval
+    # protocol): each user's LAST interaction is the test target
+    held: Dict[int, set] = {}
+    train_mask = np.ones(len(rows), dtype=bool)
+    order = np.argsort(rows, kind="stable")
+    start = 0
+    while start < len(order):
+        end = start
+        while end < len(order) and rows[order[end]] == rows[order[start]]:
+            end += 1
+        if end - start >= 2:
+            last = order[end - 1]
+            train_mask[last] = False
+            held[int(rows[last])] = {int(cols[last])}
+        start = end
+    tr, tc, tv = rows[train_mask], cols[train_mask], vals[train_mask]
+    if not len(tr):
+        print(f"[ERROR] app {app_name!r}: no training interactions "
+              "left after the leave-last-out split", file=sys.stderr)
+        return 1
+
+    user_side, item_side = _als.bucket_ratings_pair(
+        tr, tc, tv, len(users), len(items))
+    user_side, item_side = user_side.to_device(), item_side.to_device()
+
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.templates.recommendation.engine import (
+        DataSourceParams,
+    )
+
+    ep_base = EngineParams(
+        data_source_params=("", DataSourceParams(
+            app_name=str(app_name), event_names=tuple(event_names))))
+    print(f"[INFO] grid eval: {grid.k} configs x "
+          f"{int(grid.base.num_iterations)} iterations on "
+          f"{len(tr)} train / {len(held)} held-out interactions "
+          f"({len(users)} users, {len(items)} items)")
+    board = wf_tuning.run_grid(
+        user_side, item_side, grid, train_rows=tr, train_cols=tc,
+        held=held, topk=int(getattr(args, "topk", 10) or 10),
+        engine_params_base=ep_base)
+
+    from predictionio_tpu.data.storage.localfs import atomic_write_bytes
+
+    out = args.grid_out
+    atomic_write_bytes(out, json.dumps(board, indent=2).encode("utf-8"))
+    diverged = [r["config"] for r in board["rows"] if r["diverged"]]
+    if diverged:
+        print(f"[WARN] diverged configs masked out: {diverged}")
+    w = board["winner"]
+    if w is None:
+        print("[ERROR] every config diverged — no winner",
+              file=sys.stderr)
+        return 1
+    print(f"[INFO] winner: config {w['config']} {w['params']} "
+          f"{board['metricName']}={w['metric']:.4f} "
+          f"(ndcg@{board['k']}={w['ndcgAtK']:.4f}); leaderboard -> {out}")
+    return 0
+
+
 def cmd_eval(args) -> int:
     """Console eval (Console.scala:750-757): evaluation class + optional
-    params-generator class -> run_evaluation."""
+    params-generator class -> run_evaluation. With ``--grid``, the
+    vmapped multi-config tuning lane instead (:func:`_cmd_eval_grid`)."""
+    if getattr(args, "grid", None):
+        return _cmd_eval_grid(args)
+    if not args.evaluation:
+        print("[ERROR] eval needs an Evaluation class "
+              "(module:callable) or --grid grid.json", file=sys.stderr)
+        return 1
     from predictionio_tpu.controller.evaluation import (
         Evaluation, EngineParamsGenerator)
     from predictionio_tpu.data.storage.base import EvaluationInstance

@@ -310,6 +310,9 @@ class TrainCheckpointer:
         self.cfg = cfg
         self.fingerprint = fingerprint
         self.total = int(total_iterations)
+        # auxiliary manifest payload of the checkpoint most recently
+        # resumed from (e.g. the grid loop's alive mask); {} otherwise
+        self.resumed_extra: dict = {}
         os.makedirs(cfg.directory, exist_ok=True)
 
     @property
@@ -322,11 +325,14 @@ class TrainCheckpointer:
 
     # -- write path ------------------------------------------------------
 
-    def save(self, step: int, X: np.ndarray, Y: np.ndarray) -> str:
+    def save(self, step: int, X: np.ndarray, Y: np.ndarray,
+             extra: Optional[dict] = None) -> str:
         """Atomically persist the factor pair at ``step``. Blob first,
         manifest second: a crash between the two leaves a blob no
         manifest commits — invisible to resume, exactly like a torn
-        batchpredict shard."""
+        batchpredict shard. ``extra`` is an optional JSON-able payload
+        stored in the manifest (the grid loop's per-config alive mask
+        lives there) and surfaced on resume via ``resumed_extra``."""
         from predictionio_tpu.utils import faults, metrics
 
         X = np.asarray(X, dtype=np.float32)
@@ -358,6 +364,8 @@ class TrainCheckpointer:
             "createdAt": _dt.datetime.now(
                 tz=_dt.timezone.utc).isoformat(),
         }
+        if extra:
+            manifest["extra"] = extra
         atomic_write_bytes(
             os.path.join(self.cfg.directory, name + ".json"),
             json.dumps(manifest, indent=1).encode("utf-8"))
@@ -456,6 +464,8 @@ class TrainCheckpointer:
             logger.info("resuming from checkpoint %s (iteration %d/%d)",
                         _ckpt_name(step), step, self.total)
             metrics.TRAIN_CHECKPOINTS.inc(status="resumed")
+            extra = manifest.get("extra")
+            self.resumed_extra = extra if isinstance(extra, dict) else {}
             return int(manifest["step"]), X, Y
         if self._steps() or glob.glob(os.path.join(
                 self.cfg.directory, "ckpt-*.npz")):
@@ -599,3 +609,139 @@ def run_chunked(run_iters: Callable[[Any, Any, int], Tuple[Any, Any]],
                 f"{step}/{total} in {ckpt.directory}; resume with "
                 f"pio train --resume")
     return X, Y
+
+
+# ---------------------------------------------------------------------------
+# The grid (multi-config) chunked loop
+# ---------------------------------------------------------------------------
+
+_grid_finite_jit = None
+_grid_mask_jit = None
+
+
+def _grid_factors_finite(X, Y) -> np.ndarray:
+    """Per-config finiteness of stacked ``[k, N, R]`` factor carries:
+    one fused device reduction to a ``[k]`` bool vector — the grid
+    analog of :func:`_factors_finite`."""
+    global _grid_finite_jit
+    if _grid_finite_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        _grid_finite_jit = jax.jit(
+            lambda X, Y: jnp.isfinite(X).all(axis=(1, 2))
+            & jnp.isfinite(Y).all(axis=(1, 2)))
+    return np.asarray(_grid_finite_jit(X, Y))
+
+
+def _mask_dead_configs(X, Y, alive: np.ndarray):
+    """Zero the factor lanes of dead configs on device. Zero factors
+    are usually a fixed point of the ALS half-step (zero Y -> zero
+    Gram/corr and zero rhs -> zero solution, the pad ridge keeping A
+    nonsingular) — but NOT when the divergence source is an
+    overflow-to-inf hyperparameter (``inf * 0 = nan`` regenerates NaN
+    from zeros), so the guard re-applies the mask after EVERY chunk a
+    dead lane exists: cheap (one elementwise where), and no control
+    flow inside the compiled program either way."""
+    global _grid_mask_jit
+    if _grid_mask_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        _grid_mask_jit = jax.jit(
+            lambda X, Y, m: (jnp.where(m[:, None, None], X,
+                                       jnp.zeros((), X.dtype)),
+                             jnp.where(m[:, None, None], Y,
+                                       jnp.zeros((), Y.dtype))))
+    import jax.numpy as jnp
+
+    return _grid_mask_jit(X, Y, jnp.asarray(alive))
+
+
+def run_chunked_grid(run_iters: Callable[[Any, Any, int],
+                                         Tuple[Any, Any]],
+                     X: Any, Y: Any, total_iterations: int,
+                     ckpt: Optional[TrainCheckpointer], *,
+                     to_host: Callable[[Any], np.ndarray],
+                     from_host: Callable[[np.ndarray], Any]
+                     ) -> Tuple[Any, Any, np.ndarray]:
+    """:func:`run_chunked` for the vmapped config grid: the factor
+    carries are stacked ``[k, ...]`` and divergence is PER-CONFIG — a
+    non-finite config is masked out (factors zeroed, lane frozen; see
+    :func:`_mask_dead_configs`) and counted, while its neighbors keep
+    training; the whole run aborts only when EVERY config is dead. The
+    alive mask rides the checkpoint manifest's ``extra`` block, so
+    resume-mid-grid does not resurrect a masked config. Returns
+    ``(X, Y, alive)`` with ``alive`` a host ``[k]`` bool vector."""
+    from predictionio_tpu.utils import metrics
+
+    total = int(total_iterations)
+    k = int(np.shape(X)[0])
+    alive = np.ones(k, dtype=bool)
+
+    def guard_and_mask(X, Y, alive, step):
+        finite = _grid_factors_finite(X, Y)
+        newly_dead = alive & ~finite
+        for idx in np.flatnonzero(newly_dead):
+            logger.warning(
+                "grid config %d diverged after iteration %d/%d; "
+                "masking it out (factors zeroed, neighbors "
+                "unaffected)", int(idx), step, total)
+            metrics.TRAIN_DIVERGED.inc()
+        alive = alive & finite
+        if not alive.all():
+            # re-mask EVERY chunk a dead lane exists, not just on the
+            # transition: an inf hyperparameter regenerates NaN from
+            # the zeroed factors (inf * 0), see _mask_dead_configs
+            X, Y = _mask_dead_configs(X, Y, alive)
+        return X, Y, alive
+
+    if ckpt is None:
+        X, Y = run_iters(X, Y, total)
+        X, Y, alive = guard_and_mask(X, Y, alive, total)
+        if not alive.any():
+            raise TrainingDivergedError(
+                f"every grid config diverged within {total} "
+                "iterations; nothing to return")
+        return X, Y, alive
+
+    step = 0
+    resumed = ckpt.resume_state()
+    if resumed is not None:
+        step, Xh, Yh = resumed
+        if step > total:
+            raise CheckpointMismatchError(
+                f"checkpoint step {step} exceeds this run's "
+                f"num_iterations={total}")
+        if tuple(Xh.shape) != tuple(np.shape(X)) \
+                or tuple(Yh.shape) != tuple(np.shape(Y)):
+            raise CheckpointMismatchError(
+                f"checkpoint factor shapes X{tuple(Xh.shape)}/"
+                f"Y{tuple(Yh.shape)} do not match this grid's "
+                f"X{tuple(np.shape(X))}/Y{tuple(np.shape(Y))}; "
+                "refusing to resume")
+        saved = ckpt.resumed_extra.get("aliveConfigs")
+        if isinstance(saved, list) and len(saved) == k:
+            alive = np.asarray(saved, dtype=bool)
+        X, Y = from_host(Xh), from_host(Yh)
+        if not alive.all():
+            # re-apply the mask: the blob already carries zeros for
+            # dead lanes, but from_host may have round-tripped dtype
+            X, Y = _mask_dead_configs(X, Y, alive)
+    for n in chunk_schedule(total - step, ckpt.every):
+        X, Y = run_iters(X, Y, int(n))
+        step += n
+        X, Y, alive = guard_and_mask(X, Y, alive, step)
+        if not alive.any():
+            raise TrainingDivergedError(
+                f"every grid config diverged by iteration {step}/"
+                f"{total}; aborting (last intact checkpoint retained "
+                f"in {ckpt.directory})")
+        ckpt.save(step, to_host(X), to_host(Y),
+                  extra={"aliveConfigs": [bool(a) for a in alive],
+                         "gridK": k})
+        if step < total and stop_requested():
+            raise TrainingPreempted(
+                f"stop requested: grid checkpoint saved at iteration "
+                f"{step}/{total} in {ckpt.directory}; rerun to resume")
+    return X, Y, alive

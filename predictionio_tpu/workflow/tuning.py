@@ -1,0 +1,192 @@
+"""HBM-aware grid tuning scheduler: size the vmapped config batch
+against the live device memory budget, fall back to serial sub-batches
+when k factor sets don't fit.
+
+The vmapped grid (``ops/tuning.py``) holds ONE copy of the bucketed
+ratings tables plus k stacked factor sets. The tables are a sunk cost;
+the factor sets scale linearly with k and with the grid's max rank, so
+on a busy device (serving stores resident, AOT executables pinned) an
+oversized grid would OOM at dispatch. :func:`plan_grid_batches` turns
+the budget (jax ``memory_stats`` when the backend reports one, the
+``PIO_TUNING_HBM_BUDGET`` env override, minus whatever
+``memory_report``/``ladder_report`` dicts the caller passes for stores
+about to be deployed) into ordered sub-batches; :func:`run_grid` trains
+them back-to-back — lanes are independent under vmap and each config's
+init depends only on its own params, so sub-batched results are
+EXACTLY the full-grid results (differential-gated in
+tests/test_tuning_grid.py) — and merges one leaderboard, the winner
+pinned with its full EngineParams."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.ops import als as _als
+from predictionio_tpu.ops import tuning as _tuning
+from predictionio_tpu.ops.tuning import ConfigGrid, GridTrainResult
+from predictionio_tpu.workflow.checkpoint import TrainingDivergedError
+
+logger = logging.getLogger("predictionio_tpu.workflow.tuning")
+
+
+def _report_bytes(report: Optional[Mapping]) -> int:
+    """Pull the byte total out of a PR-12 ``memory_report`` /
+    ``ladder_report`` dict (both spell it ``totalBytes``; the ladder
+    nests it under ``memory``)."""
+    if not isinstance(report, Mapping):
+        return 0
+    total = int(report.get("totalBytes", 0) or 0)
+    nested = report.get("memory")
+    if isinstance(nested, Mapping):
+        total += int(nested.get("totalBytes", 0) or 0)
+    return total
+
+
+def hbm_budget_bytes(reports: Sequence[Mapping] = ()) -> Optional[int]:
+    """Free device memory available to the grid, or None when the
+    backend doesn't report one (CPU — no meaningful HBM ceiling).
+    ``PIO_TUNING_HBM_BUDGET`` (bytes) overrides for tests and for
+    operators who want a softer ceiling; ``reports`` are byte totals to
+    reserve for stores the caller is about to deploy on top."""
+    reserved = sum(_report_bytes(r) for r in reports)
+    forced = os.environ.get("PIO_TUNING_HBM_BUDGET", "").strip()
+    if forced:
+        return max(0, int(forced) - reserved)
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if not stats:
+            return None
+        limit = stats.get("bytes_limit")
+        used = stats.get("bytes_in_use", 0)
+        if not limit:
+            return None
+        return max(0, int(limit) - int(used) - reserved)
+    except Exception:  # pragma: no cover - backend without stats
+        return None
+
+
+def grid_bytes_per_config(n_users: int, n_items: int, grid: ConfigGrid,
+                          user_side=None, item_side=None) -> int:
+    """Honest-estimate HBM bytes ONE config adds to the grid program:
+    its stacked factor pair x2 (donation still peaks at old+new during
+    the carry swap) plus its slice of the dominant solve transients —
+    the largest bucket's ``[B, L, R]`` factor gather and ``[B, R, R]``
+    normal-equation batch. The shared bucket tables are NOT counted:
+    they are resident once regardless of k (the whole point)."""
+    r = grid.max_rank
+    itemsize = 2 if _als._als_precision_mode(grid.base) == "bf16" else 4
+    factors = (int(n_users) + int(n_items)) * r * itemsize * 2
+    transient = 0
+    for side in (user_side, item_side):
+        if side is None:
+            continue
+        for b in side.buckets:
+            rows, length = int(b.cols.shape[0]), int(b.cols.shape[1])
+            budget = grid.base.bucket_slot_budget
+            if budget and rows * length > int(budget):
+                rows = max(8, (int(budget) // length) // 8 * 8)
+            transient = max(transient,
+                            rows * length * r * itemsize  # gather
+                            + rows * r * r * 4)           # fp32 A batch
+    return factors + transient
+
+
+def plan_grid_batches(grid: ConfigGrid, n_users: int, n_items: int,
+                      user_side=None, item_side=None,
+                      budget_bytes: Optional[int] = None,
+                      reports: Sequence[Mapping] = ()) -> List[List[int]]:
+    """Ordered config-index batches sized to the HBM budget. No budget
+    (CPU, or stats unavailable) -> one batch, the whole grid. A budget
+    smaller than a single config still yields 1-config batches — the
+    serial fallback IS the k=1 degenerate grid, same program."""
+    k = grid.k
+    if budget_bytes is None:
+        budget_bytes = hbm_budget_bytes(reports)
+    if budget_bytes is None:
+        return [list(range(k))]
+    per = max(1, grid_bytes_per_config(n_users, n_items, grid,
+                                       user_side, item_side))
+    max_k = max(1, int(budget_bytes) // per)
+    batches = [list(range(i, min(i + max_k, k)))
+               for i in range(0, k, max_k)]
+    if len(batches) > 1:
+        logger.info(
+            "grid of %d configs exceeds the HBM budget (%d bytes, ~%d "
+            "bytes/config): training %d sub-batches of <= %d",
+            k, budget_bytes, per, len(batches), max_k)
+    return batches
+
+
+def run_grid(user_side, item_side, grid: ConfigGrid, *,
+             train_rows: np.ndarray, train_cols: np.ndarray,
+             held: Mapping[int, set], topk: int = 10,
+             budget_bytes: Optional[int] = None,
+             reports: Sequence[Mapping] = (),
+             engine_params_base=None, algo_name: str = "als",
+             warmup: bool = True) -> Dict[str, Any]:
+    """Train the whole grid (sub-batched to the HBM budget), evaluate
+    every config on device, and return the leaderboard artifact:
+    ``rows`` best-first, ``winner`` pinned with its full EngineParams
+    (when ``engine_params_base`` is given), plus the schedule the
+    batches actually ran under."""
+    n_users, n_items = user_side.n_rows, item_side.n_rows
+    if budget_bytes is None:
+        budget_bytes = hbm_budget_bytes(reports)
+    batches = plan_grid_batches(grid, n_users, n_items, user_side,
+                                item_side, budget_bytes, reports)
+    r_max = grid.max_rank
+    uf = np.zeros((grid.k, n_users, r_max), np.float32)
+    itf = np.zeros((grid.k, n_items, r_max), np.float32)
+    alive = np.zeros(grid.k, dtype=bool)
+    for batch in batches:
+        sub = grid.subset(batch)
+        if warmup:
+            _als.warmup_train_als_bucketed(user_side, item_side, sub)
+        try:
+            res = _tuning.train_als_grid_bucketed(user_side, item_side,
+                                                  sub)
+        except TrainingDivergedError as e:
+            # a fully-diverged SUB-BATCH must not kill the sweep: its
+            # configs are already counted dead (the per-chunk guard
+            # fired before the abort); neighbors in other batches keep
+            # their lanes. Factors stay zero, alive stays False.
+            logger.warning(
+                "grid sub-batch %s diverged entirely (%s); its configs "
+                "are marked dead, remaining batches continue", batch, e)
+            continue
+        for j, i in enumerate(batch):
+            r = int(sub.configs[j].rank)
+            uf[i, :, :r] = res.user_factors[j][:, :r]
+            itf[i, :, :r] = res.item_factors[j][:, :r]
+            alive[i] = res.alive[j]
+    merged = GridTrainResult(user_factors=uf, item_factors=itf,
+                             grid=grid, alive=alive)
+    board = _tuning.grid_leaderboard(merged, train_rows, train_cols,
+                                     held, topk=topk)
+    board["gridK"] = grid.k
+    board["batches"] = [len(b) for b in batches]
+    board["hbmBudgetBytes"] = budget_bytes
+    if board["winner"] is not None and engine_params_base is not None:
+        from predictionio_tpu.controller.engine import (
+            expand_engine_params,
+        )
+        from predictionio_tpu.controller.evaluation import (
+            _engine_params_to_jsonable,
+        )
+
+        variants = expand_engine_params(
+            engine_params_base, algo_name,
+            [grid.configs[r["config"]] for r in board["rows"]])
+        for row, ep in zip(board["rows"], variants):
+            if row["config"] == board["winner"]["config"]:
+                board["winner"]["engineParams"] = \
+                    _engine_params_to_jsonable(ep)
+        # rows keep only sweep coordinates; the winner carries the full
+        # trainable parameterization (the MetricEvaluator idiom)
+    return board

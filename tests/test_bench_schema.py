@@ -57,6 +57,95 @@ class TestArtifactSchema:
         assert bench._stamp_device(None) is None
 
 
+class TestLeaderboardSchema:
+    """ISSUE-16 satellite: a lane embedding a tuning leaderboard is
+    schema-checked too — malformed rows or a dropped winner fail the
+    bench run, not a future leaderboard reader."""
+
+    def _lane(self):
+        rows = [
+            {"config": 0, "params": {"rank": 8, "lambda": 0.1,
+                                     "alpha": 1.0},
+             "diverged": False, "metric": 0.12},
+            {"config": 1, "params": {"rank": 8, "lambda": 0.9,
+                                     "alpha": 1.0},
+             "diverged": True, "metric": None},
+        ]
+        return bench._stamp_device(
+            {"leaderboard": rows, "winner": dict(rows[0])})
+
+    def _artifact(self, lane):
+        return {"accelerator": False,
+                "detail": {"tuning_grid": lane}}
+
+    def test_wellformed_leaderboard_conforms(self):
+        assert bench.artifact_schema_problems(
+            self._artifact(self._lane())) == []
+
+    def test_empty_or_non_list_leaderboard_is_caught(self):
+        for bad in ([], None, "x"):
+            lane = self._lane()
+            lane["leaderboard"] = bad
+            problems = bench.artifact_schema_problems(
+                self._artifact(lane))
+            assert any("non-empty list" in p for p in problems)
+
+    def test_row_missing_required_keys_is_caught(self):
+        for key in ("config", "params", "diverged"):
+            lane = self._lane()
+            del lane["leaderboard"][0][key]
+            problems = bench.artifact_schema_problems(
+                self._artifact(lane))
+            assert any(key in p for p in problems), key
+
+    def test_live_row_without_numeric_metric_is_caught(self):
+        lane = self._lane()
+        lane["leaderboard"][0]["metric"] = None
+        problems = bench.artifact_schema_problems(self._artifact(lane))
+        assert any("numeric 'metric'" in p for p in problems)
+        # a diverged row may carry metric None — that's the contract
+        lane2 = self._lane()
+        assert bench.artifact_schema_problems(
+            self._artifact(lane2)) == []
+
+    def test_missing_or_inconsistent_winner_is_caught(self):
+        lane = self._lane()
+        del lane["winner"]
+        problems = bench.artifact_schema_problems(self._artifact(lane))
+        assert any("winner" in p for p in problems)
+        # winner None is only legal when EVERY config diverged
+        lane2 = self._lane()
+        lane2["winner"] = None
+        problems = bench.artifact_schema_problems(self._artifact(lane2))
+        assert any("live configs exist" in p for p in problems)
+        lane3 = self._lane()
+        for row in lane3["leaderboard"]:
+            row["diverged"], row["metric"] = True, None
+        lane3["winner"] = None
+        assert bench.artifact_schema_problems(
+            self._artifact(lane3)) == []
+
+
+class TestTuningGridLaneWiring:
+    @pytest.mark.tuning
+    def test_tuning_grid_smoke_end_to_end(self):
+        """The CPU-sized tuning_grid shape runs end to end: leaderboard
+        embedded and schema-clean, zero-compile steady state, and the
+        vmapped program beats k serial trains (the wiring `main` runs
+        in --smoke)."""
+        r = bench.tuning_grid_bench(n_users=120, n_items=60, nnz=2500,
+                                    iterations=2, grid_size=4, rank=4)
+        assert r["device"]
+        assert r["zero_compile_steady_state"] is True
+        assert r["aot_warmed"] is True
+        assert r["speedup_vs_serial"] > 1
+        assert r["winner"] is not None
+        assert len(r["leaderboard"]) == 4
+        assert r["max_abs_diff_vs_serial"] < 1e-4
+        art = {"accelerator": False, "detail": {"tuning_grid": r}}
+        assert bench.artifact_schema_problems(art) == []
+
+
 class TestScale1bLaneWiring:
     @pytest.mark.multichip
     def test_scale_1b_smoke_end_to_end(self):

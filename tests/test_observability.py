@@ -100,10 +100,26 @@ class TestEventServerMetrics:
         key = ("pio_http_requests_total",
                (("method", "POST"), ("route", "/events.json"),
                 ("server", "event"), ("status", "201")))
+
+        def settled_scrape():
+            # the status-labeled counter increments AFTER the response
+            # bytes are on the wire (the dispatch shell's finally), so
+            # an immediate scrape can race an in-flight increment —
+            # poll until the counter is quiescent across two scrapes
+            end = time.monotonic() + 5.0
+            s, _ = scrape(addr)
+            while time.monotonic() < end:
+                time.sleep(0.02)
+                s2, _ = scrape(addr)
+                if s2.get(key, 0) == s.get(key, 0):
+                    return s2
+                s = s2
+            return s
+
         raw_request(addr, "POST", f"/events.json?accessKey={KEY}", body=RATE)
-        s1, _ = scrape(addr)
+        s1 = settled_scrape()
         raw_request(addr, "POST", f"/events.json?accessKey={KEY}", body=RATE)
-        s2, _ = scrape(addr)
+        s2 = settled_scrape()
         assert s2[key] == s1[key] + 1
         # cumulative le buckets: monotone, +Inf equals _count
         hkey = (("route", "/events.json"), ("server", "event"))

@@ -504,6 +504,18 @@ class TestServerTraces:
              "targetEntityType": "item", "targetEntityId": "i1",
              "properties": {"rating": 4.0}}
 
+    @staticmethod
+    def _wait_for(probe, deadline_sec=5.0):
+        """Retention happens when the server span EXITS — after the
+        response bytes are already on the wire — so an immediate read
+        of the buffer races the flush by design. Poll briefly."""
+        end = time.monotonic() + deadline_sec
+        while True:
+            got = probe()
+            if got or time.monotonic() >= end:
+                return got
+            time.sleep(0.005)
+
     def test_request_trace_covers_http_and_storage(self, event_server,
                                                    traces):
         tp = f"00-{'9a' * 16}-{'7b' * 8}-01"
@@ -515,7 +527,7 @@ class TestServerTraces:
         echoed = parse_traceparent(headers["traceparent"])
         assert echoed.trace_id == "9a" * 16
         assert echoed.span_id != "7b" * 8
-        rec = traces.get("9a" * 16)
+        rec = self._wait_for(lambda: traces.get("9a" * 16))
         assert rec is not None
         names = {s["name"] for s in rec["spans"]}
         assert "event POST /events.json" in names
@@ -528,6 +540,7 @@ class TestServerTraces:
     def test_traces_endpoints(self, event_server, traces):
         self._request(event_server.address, "POST",
                       "/events.json?accessKey=trkey", body=self.EVENT)
+        self._wait_for(lambda: traces.index())
         status, data, _ = self._request(event_server.address, "GET",
                                         "/traces.json")
         assert status == 200
@@ -553,6 +566,7 @@ class TestServerTraces:
         traces.slow_threshold_sec = 0.0  # every request is "slow"
         self._request(event_server.address, "POST",
                       "/events.json?accessKey=trkey", body=self.EVENT)
+        self._wait_for(lambda: traces.index())
         _, data, _ = self._request(event_server.address, "GET",
                                    "/traces.json")
         slow = json.loads(data)["slowLog"]
@@ -586,7 +600,7 @@ class TestServerTraces:
             le._wrapped.insert = orig
         assert status == 500
         tid = parse_traceparent(headers["traceparent"]).trace_id
-        rec = traces.get(tid)
+        rec = self._wait_for(lambda: traces.get(tid))
         assert rec is not None and rec["error"] is True
         names = {s["name"]: s for s in rec["spans"]}
         assert names["storage.memory.insert"]["error"] is True
