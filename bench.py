@@ -248,6 +248,106 @@ def train_resume_bench(n_users: int = N_USERS, n_items: int = N_ITEMS,
     }
 
 
+def train_telemetry_overhead_bench(
+        n_users: int = N_USERS, n_items: int = N_ITEMS, nnz: int = NNZ,
+        iterations: int = ITERATIONS, checkpoint_every: int = 5,
+        repeats: int = 3, seed: int = 7) -> dict:
+    """Training-plane observability tax (ISSUE 17): checkpointed
+    training with PIO_TRAIN_TELEMETRY on vs off. The on lane computes
+    the fused on-device objective once per chunk (one scalar-pack D2H),
+    appends run-history samples, and publishes the loss gauges/spans;
+    the off lane is the bare crash-safe loop. Lane order alternates per
+    repeat, ONE ratio of per-lane best-of-N minima, the <3% overhead
+    gate, the pure-observer byte-identity stamp (telemetry must never
+    perturb the factors), and the zero-compile steady-state gate (the
+    objective program is compiled during warm-up, so the timed repeats
+    must not compile anything)."""
+    import os
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.ops.als import ALSParams, train_als
+    from predictionio_tpu.utils import metrics
+    from predictionio_tpu.workflow import runlog
+
+    params = ALSParams(rank=RANK, num_iterations=iterations,
+                       lambda_=LAMBDA, alpha=ALPHA, seed=seed)
+    user_side, item_side, processed = make_sides(n_users, n_items, nnz,
+                                                 seed)
+    user_side, item_side = to_device(user_side), to_device(item_side)
+
+    env_keys = ("PIO_CHECKPOINT_DIR", "PIO_CHECKPOINT_EVERY",
+                "PIO_CHECKPOINT_KEEP", "PIO_RESUME",
+                "PIO_TRAIN_TELEMETRY")
+    saved_env = {k: os.environ.pop(k) for k in env_keys
+                 if k in os.environ}
+    tmp_on = tempfile.mkdtemp(prefix="pio_train_telemetry_on_")
+    tmp_off = tempfile.mkdtemp(prefix="pio_train_telemetry_off_")
+    try:
+        os.environ["PIO_CHECKPOINT_EVERY"] = str(checkpoint_every)
+        os.environ["PIO_CHECKPOINT_KEEP"] = "3"
+
+        def lane(telemetry: bool):
+            os.environ["PIO_CHECKPOINT_DIR"] = \
+                tmp_on if telemetry else tmp_off
+            os.environ["PIO_TRAIN_TELEMETRY"] = \
+                "1" if telemetry else "0"
+            try:
+                t0 = time.perf_counter()
+                out = train_als(user_side, item_side, params)
+                return time.perf_counter() - t0, out
+            finally:
+                os.environ.pop("PIO_CHECKPOINT_DIR", None)
+                os.environ.pop("PIO_TRAIN_TELEMETRY", None)
+
+        # warm BOTH lanes (train chunks + the on lane's objective
+        # program) before the compile counter is read or time is kept
+        metrics.install_jit_compile_listener()
+        _, (X_off, Y_off) = lane(False)
+        _, (X_on, Y_on) = lane(True)
+        byte_identical = bool(np.array_equal(X_off, X_on)
+                              and np.array_equal(Y_off, Y_on))
+
+        compiles0 = metrics.JIT_COMPILES.value()
+        best_off, best_on = float("inf"), float("inf")
+        for i in range(repeats):
+            order = (False, True) if i % 2 == 0 else (True, False)
+            for telemetry in order:
+                dt, _ = lane(telemetry)
+                if telemetry:
+                    best_on = min(best_on, dt)
+                else:
+                    best_off = min(best_off, dt)
+        jit_delta = metrics.JIT_COMPILES.value() - compiles0
+        overhead = (best_on - best_off) / best_off
+
+        runs = runlog.list_runs(tmp_on)
+        samples = sum(r["samples"] for r in runs)
+    finally:
+        for k in env_keys:
+            os.environ.pop(k, None)
+        os.environ.update(saved_env)
+        shutil.rmtree(tmp_on, ignore_errors=True)
+        shutil.rmtree(tmp_off, ignore_errors=True)
+
+    return _stamp_device({
+        "n_users": n_users, "n_items": n_items, "rank": RANK,
+        "iterations": iterations, "checkpoint_every": checkpoint_every,
+        "events_processed": processed,
+        "train_sec_off": round(best_off, 4),
+        "train_sec_on": round(best_on, 4),
+        "overhead_frac": round(overhead, 4),
+        "overhead_gate_pass": bool(overhead < 0.03),
+        # the pure-observer contract: telemetry on/off factors must be
+        # byte-identical — the objective only READS the resident tables
+        "factors_byte_identical": byte_identical,
+        "jit_compiles_steady_state": int(jit_delta),
+        "zero_compile_steady_state": jit_delta == 0,
+        "runs_recorded": len(runs),
+        "loss_samples_recorded": int(samples),
+    })
+
+
 def als_precision_bench(n_users: int = N_USERS, n_items: int = N_ITEMS,
                         nnz: int = NNZ, rank: int = RANK,
                         iterations: int = ITERATIONS, seed: int = 7,
@@ -825,6 +925,16 @@ def artifact_schema_problems(artifact: dict) -> list:
             problems.append(f"lane {name!r} missing 'device' stamp")
         if isinstance(lane, dict) and "leaderboard" in lane:
             problems.extend(_leaderboard_schema_problems(name, lane))
+        if isinstance(lane, dict) and name == "train_telemetry":
+            # the ISSUE-17 gates are part of the artifact contract: the
+            # telemetry lane must self-report its observer-purity and
+            # compile stamps, not just a wall-clock number
+            for key in ("overhead_frac", "overhead_gate_pass",
+                        "factors_byte_identical",
+                        "zero_compile_steady_state"):
+                if key not in lane:
+                    problems.append(
+                        f"lane {name!r} missing gate key {key!r}")
     return problems
 
 
@@ -2720,6 +2830,14 @@ def main(smoke: bool = False) -> None:
             "iterations": 16, "checkpoint_every": 8,
             "repeats": 4} if smoke else {}))
 
+    # training-plane telemetry tax (ISSUE 17): the per-chunk objective
+    # + run-history appends vs the bare checkpoint loop — <3% gate,
+    # pure-observer byte-identity, zero-compile steady state
+    train_telemetry = train_telemetry_overhead_bench(
+        **({"n_users": 600, "n_items": 400, "nnz": 20_000,
+            "iterations": 16, "checkpoint_every": 8,
+            "repeats": 4} if smoke else {}))
+
     # vmapped multi-config training (ISSUE 16): one device program
     # advances the whole 8-config grid vs 8 serial trains (which also
     # pay 8 compiles — lambda is static in the serial jit). Leaderboard
@@ -2789,6 +2907,7 @@ def main(smoke: bool = False) -> None:
         "scale_100m": scale100,
         "scale_1b": scale1b,
         "train_resume": train_resume,
+        "train_telemetry": train_telemetry,
         "tuning_grid": tuning_grid,
         "precision_lanes": precision,
         "quality": quality,
@@ -2848,6 +2967,14 @@ def main(smoke: bool = False) -> None:
         "train_ckpt_overhead_frac": train_resume["overhead_frac"],
         "train_ckpt_overhead_gate": train_resume["overhead_gate_pass"],
         "train_resume_equal": train_resume["resumed_equal"],
+        "train_telemetry_overhead_frac":
+            train_telemetry["overhead_frac"],
+        "train_telemetry_overhead_gate":
+            train_telemetry["overhead_gate_pass"],
+        "train_telemetry_pure_observer":
+            train_telemetry["factors_byte_identical"],
+        "train_telemetry_zero_compiles":
+            train_telemetry["zero_compile_steady_state"],
         "tuning_grid_speedup_vs_serial":
             tuning_grid["speedup_vs_serial"],
         "tuning_grid_speedup_gate":

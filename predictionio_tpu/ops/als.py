@@ -1201,6 +1201,221 @@ def _grid_call_args(user_side: BucketedRatings,
     return args, kw
 
 
+# ---------------------------------------------------------------------------
+# Training-objective telemetry: a fused on-device reduction of the loss
+# each train_als* flavor actually optimizes, evaluated once per
+# checkpoint chunk against the already-resident solve tables. Pure
+# observer: it reads the post-chunk factor carries (never donated), one
+# scalar-pack D2H per sample, and the whole plane dies with
+# PIO_TRAIN_TELEMETRY=0 (workflow/runlog.py::telemetry_enabled).
+# ---------------------------------------------------------------------------
+
+
+def _objective_pack_impl(X, Y, u_buckets, *, lam, alpha, implicit):
+    """``[fit, l2, finite]`` float32 pack of the training objective.
+
+    Implicit (Hu-Koren-Volinsky — what :func:`_solve_rows` minimizes):
+    ``L = sum_{u,i} c_ui (p_ui - x_u.y_i)^2 + lam (|X|^2 + |Y|^2)``
+    with confidence ``c = 1 + alpha|r|`` on observed pairs (1
+    elsewhere) and preference ``p = 1`` iff ``r > 0``. The quadratic
+    over ALL (u, i) pairs collapses through the Gram matrix —
+    ``sum_u x_u^T (Y^T Y) x_u`` — plus a correction over just the
+    observed entries: ``c(p-s)^2 - s^2 = bw - 2 bw s + aw s^2`` with
+    ``s = x_u.y_i`` and ``(aw, bw)`` exactly :func:`implicit_weights`,
+    so the objective shares the solver's weighting to the letter.
+
+    Explicit (ALS-WR): ``L = sum_obs (r - s)^2 + lam (sum_u n_u|x_u|^2
+    + sum_i n_i|y_i|^2)``; both item-side terms come off the USER-side
+    tables (``sum_i n_i|y_i|^2`` equals the table-entry sum of
+    ``mask * |Y[col]|^2``), so one solve side feeds the whole pack.
+
+    Truncated tables (``max_len`` caps) contribute exactly the pairs
+    the solver sees — the objective tracks what training optimizes,
+    not a hypothetical untruncated loss. ``finite`` fuses the
+    divergence guard (``isfinite`` over both carries) into the same
+    program, so the chunk loop pays ONE D2H for guard + loss, and the
+    guard stays exact even when a huge-but-finite loss overflows.
+    fp32 accumulation throughout (bf16 factor stores cast up once).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    hi = jax.lax.Precision.HIGHEST
+    finite = (jnp.isfinite(X).all() & jnp.isfinite(Y).all()).astype(f32)
+    Xf = X.astype(f32)
+    Yf = Y.astype(f32)
+    fit = jnp.zeros((), f32)
+    l2n = jnp.zeros((), f32)  # explicit ALS-WR count-weighted norms
+    if implicit:
+        G = jnp.matmul(Yf.T, Yf, precision=hi)
+        fit = fit + jnp.einsum("nr,rs,ns->", Xf, G, Xf, precision=hi)
+    for row_ids, cols, w, m in u_buckets:
+        # sentinel pad ids sit one past the end: clip (the fill-mode
+        # default would turn w=0 pad slots into 0*NaN poison)
+        Xb = jnp.take(Xf, row_ids, axis=0, mode="clip")   # [B, R]
+        Yg = jnp.take(Yf, cols, axis=0, mode="clip")
+        s = jnp.einsum("blr,br->bl", Yg, Xb, precision=hi)
+        m32 = m.astype(f32)
+        wm = w.astype(f32) * m32               # pads -> aw = bw = 0
+        if implicit:
+            aw, bw = implicit_weights(wm, alpha)
+            fit = fit + jnp.sum(bw - 2.0 * bw * s + aw * s * s)
+        else:
+            fit = fit + jnp.sum(m32 * (wm - s) ** 2)
+            l2n = l2n + jnp.sum(jnp.sum(m32, axis=1)
+                                * jnp.sum(Xb * Xb, axis=1))
+            l2n = l2n + jnp.einsum("bl,blr->", m32, Yg * Yg,
+                                   precision=hi)
+    if implicit:
+        l2 = lam * (jnp.sum(Xf * Xf) + jnp.sum(Yf * Yf))
+    else:
+        l2 = lam * l2n
+    return jnp.stack([fit, l2, finite])
+
+
+def _objective_pack_grid_impl(X, Y, lam, alpha, u_buckets, *, implicit):
+    """Per-config ``[k, 3]`` packs: :func:`_objective_pack_impl`
+    vmapped over the stacked config axis with traced lam/alpha vectors
+    and the bucket tables broadcast — the same structure as the grid
+    training program (rank-padded factor columns are exact zeros, so
+    they add nothing to either term)."""
+    import jax
+
+    def one(Xk, Yk, lamk, alphak):
+        return _objective_pack_impl(Xk, Yk, u_buckets, lam=lamk,
+                                    alpha=alphak, implicit=implicit)
+
+    return jax.vmap(one, in_axes=(0, 0, 0, 0))(X, Y, lam, alpha)
+
+
+_objective_jit = None
+_objective_grid_jit = None
+
+_AOT_OBJECTIVE_MAX = 8
+_aot_objective = _AOTCache(_AOT_OBJECTIVE_MAX, name="train-objective")
+_aot_objective_grid = _AOTCache(_AOT_OBJECTIVE_MAX,
+                                name="train-objective-grid")
+
+
+def _get_objective_jit():
+    global _objective_jit
+    if _objective_jit is None:
+        import jax
+
+        _objective_jit = jax.jit(
+            _objective_pack_impl,
+            static_argnames=("lam", "alpha", "implicit"))
+    return _objective_jit
+
+
+def _get_objective_grid_jit():
+    global _objective_grid_jit
+    if _objective_grid_jit is None:
+        import jax
+
+        _objective_grid_jit = jax.jit(
+            _objective_pack_grid_impl, static_argnames=("implicit",))
+    return _objective_grid_jit
+
+
+def _objective_pack(*args, **kw):
+    """Jitted objective (X/Y NOT donated — the pack observes carries
+    the next chunk still trains from); a matching AOT executable from
+    the warm-up is used when present, so the per-chunk sample keeps
+    the zero-steady-state-compile contract."""
+    jitted = _get_objective_jit()
+    if len(_aot_objective):
+        compiled = _aot_objective.get(_bucketed_aot_key(args, kw))
+        if compiled is not None:
+            return compiled(*args)
+    return jitted(*args, **kw)
+
+
+def _objective_pack_grid(*args, **kw):
+    jitted = _get_objective_grid_jit()
+    if len(_aot_objective_grid):
+        compiled = _aot_objective_grid.get(_bucketed_aot_key(args, kw))
+        if compiled is not None:
+            return compiled(*args)
+    return jitted(*args, **kw)
+
+
+def _objective_statics(params) -> dict:
+    """The objective program's static kwargs for one config — shared
+    by the real per-chunk call and the AOT warm-up, so a warmed
+    signature is guaranteed to match."""
+    return dict(lam=float(params.lambda_), alpha=float(params.alpha),
+                implicit=bool(params.implicit_prefs))
+
+
+def _uniform_objective_bucket(cols, weights, mask, n_rows: int):
+    """A uniform ``[N, L]`` table viewed as the one-bucket case: table
+    row ``i`` IS factor row ``i``, so ``row_ids`` is just arange."""
+    return (np.arange(int(n_rows), dtype=np.int32), cols, weights, mask)
+
+
+def _train_telemetry_enabled() -> bool:
+    from predictionio_tpu.workflow import runlog as _runlog
+
+    return _runlog.telemetry_enabled()
+
+
+def _objective_call_args(user_side: BucketedRatings,
+                         item_side: BucketedRatings, params,
+                         precision: str, configs=None):
+    """Abstract (args, statics) of the objective program matching the
+    chunk loop's real call — lowered by the warm-up next to the
+    iteration signatures. ``configs`` switches to the vmapped grid
+    signature."""
+    import jax
+
+    def leaf(a):
+        return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+    u_t = tuple((leaf(b.row_ids), leaf(b.cols), leaf(b.weights),
+                 leaf(b.mask)) for b in user_side.buckets)
+    dt = factor_dtype(precision)
+    if configs is not None:
+        k = len(configs)
+        r_max = max(int(c.rank) for c in configs)
+        f32 = np.dtype(np.float32)
+        X = jax.ShapeDtypeStruct((k, user_side.n_rows, r_max), dt)
+        Y = jax.ShapeDtypeStruct((k, item_side.n_rows, r_max), dt)
+        lam = jax.ShapeDtypeStruct((k,), f32)
+        alpha = jax.ShapeDtypeStruct((k,), f32)
+        return ((X, Y, lam, alpha, u_t),
+                dict(implicit=bool(configs[0].implicit_prefs)))
+    X = jax.ShapeDtypeStruct((user_side.n_rows, int(params.rank)), dt)
+    Y = jax.ShapeDtypeStruct((item_side.n_rows, int(params.rank)), dt)
+    return (X, Y, u_t), _objective_statics(params)
+
+
+def training_objective(X, Y, user_side, params: ALSParams) -> dict:
+    """One objective sample for a factor pair against the USER-side
+    solve tables: ``{"fit", "l2", "total", "finite"}``.
+
+    ``user_side`` is the side whose rows align with ``X`` — a uniform
+    :class:`PaddedRatings` or a :class:`BucketedRatings`. This is the
+    public one-shot form of the fused per-chunk reduction the crash-safe
+    loop samples; factors may be host numpy or live device arrays."""
+    import jax.numpy as jnp
+
+    if isinstance(user_side, BucketedRatings):
+        u_t = tuple((b.row_ids, b.cols, b.weights, b.mask)
+                    for b in user_side.buckets)
+    else:
+        u_t = (_uniform_objective_bucket(
+            user_side.cols, user_side.weights, user_side.mask,
+            np.shape(X)[0]),)
+    pack = np.asarray(_objective_pack(
+        jnp.asarray(X), jnp.asarray(Y), u_t,
+        **_objective_statics(params)), dtype=np.float64)
+    return {"fit": float(pack[0]), "l2": float(pack[1]),
+            "total": float(pack[0] + pack[1]),
+            "finite": bool(pack[2] == 1.0)}
+
+
 def checkpoint_layout_uniform(user_side: PaddedRatings,
                               item_side: PaddedRatings):
     """Layout half of the checkpoint fingerprint for uniform tables:
@@ -1318,6 +1533,8 @@ def warmup_train_als_bucketed(user_side: BucketedRatings,
     ConfigGrid` — then the VMAPPED multi-config signature is lowered
     instead, so grid training (``train_als_grid_bucketed``) keeps the
     same zero-steady-state-compile contract as serial training."""
+    import os
+
     configs = getattr(params, "configs", None)
     try:
         from predictionio_tpu.ops import aot
@@ -1338,6 +1555,22 @@ def warmup_train_als_bucketed(user_side: BucketedRatings,
                     ok = False
                     continue
                 _aot_grid.put(key, compiled)
+            if _train_telemetry_enabled():
+                # the per-chunk objective sample joins the ladder so the
+                # telemetry plane keeps the zero-steady-state-compile
+                # contract (grid samples run even without checkpointing:
+                # the end-of-run divergence grading needs one)
+                args, okw = _objective_call_args(
+                    user_side, item_side, base, precision,
+                    configs=configs)
+                key = _bucketed_aot_key(args, okw)
+                if key not in _aot_objective_grid:
+                    compiled = aot.lower_compile(
+                        _get_objective_grid_jit(), *args, **okw)
+                    if compiled is None:
+                        ok = False
+                    else:
+                        _aot_objective_grid.put(key, compiled)
             return ok
 
         precision = _als_precision_mode(params)
@@ -1358,6 +1591,21 @@ def warmup_train_als_bucketed(user_side: BucketedRatings,
                 ok = False
                 continue
             _aot_bucketed.put(key, compiled)
+        if _train_telemetry_enabled() and os.environ.get(
+                "PIO_CHECKPOINT_DIR", "").strip():
+            # serial objective samples only run inside the chunked
+            # checkpoint loop — lower the program alongside the
+            # chunk-length scans it will interleave with
+            args, okw = _objective_call_args(user_side, item_side,
+                                             params, precision)
+            key = _bucketed_aot_key(args, okw)
+            if key not in _aot_objective:
+                compiled = aot.lower_compile(
+                    _get_objective_jit(), *args, **okw)
+                if compiled is None:
+                    ok = False
+                else:
+                    _aot_objective.put(key, compiled)
         return ok
     except Exception:
         return False
@@ -1403,10 +1651,18 @@ def train_als_bucketed(user_side: BucketedRatings,
             return _als_iterations_bucketed(
                 Xc, Yc, u_t, i_t, **dict(kw, num_iterations=int(n)))
 
+        objective = None
+        if _train_telemetry_enabled():
+            obj_kw = _objective_statics(params)
+
+            def objective(Xc, Yc):
+                return _objective_pack(Xc, Yc, u_t, **obj_kw)
+
         X, Y = _checkpoint.run_chunked(
             run_iters, X, Y, int(params.num_iterations), ckpt,
             to_host=lambda a: np.asarray(a, dtype=np.float32),
-            from_host=lambda a: jnp.asarray(a, dtype=fdt))
+            from_host=lambda a: jnp.asarray(a, dtype=fdt),
+            objective=objective)
     # host factors always land fp32: persistence, serving and the eval
     # stack stay byte-compatible regardless of the training policy
     return (np.asarray(X, dtype=np.float32),
@@ -1492,10 +1748,22 @@ def train_als(user_side: PaddedRatings, item_side: PaddedRatings,
                 Xc, Yc, u_cols, u_w, u_m, i_cols, i_w, i_m,
                 num_iterations=int(n), **kw)
 
+        objective = None
+        if _train_telemetry_enabled():
+            # the uniform table is the one-bucket case of the fused
+            # objective: row i of the table IS factor row i
+            obj_bucket = _uniform_objective_bucket(
+                u_cols, u_w, u_m, user_side.n_rows)
+            obj_kw = _objective_statics(params)
+
+            def objective(Xc, Yc):
+                return _objective_pack(Xc, Yc, (obj_bucket,), **obj_kw)
+
         X, Y = _checkpoint.run_chunked(
             run_iters, X, Y, int(params.num_iterations), ckpt,
             to_host=lambda a: np.asarray(a, dtype=np.float32),
-            from_host=lambda a: jnp.asarray(a, dtype=fdt))
+            from_host=lambda a: jnp.asarray(a, dtype=fdt),
+            objective=objective)
     # host factors always land fp32 (see train_als_bucketed)
     return (np.asarray(X, dtype=np.float32)[:n_u],
             np.asarray(Y, dtype=np.float32)[:n_i])

@@ -264,6 +264,10 @@ class GridTrainResult:
     item_factors: np.ndarray
     grid: ConfigGrid
     alive: np.ndarray
+    #: per-chunk objective samples ({"step", "fit", "l2", "total"} with
+    #: [k]-vectors holding None for dead configs) when training-plane
+    #: telemetry was on; None under PIO_TRAIN_TELEMETRY=0
+    loss_history: Optional[List[dict]] = None
 
     def factors_for(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
         """Config ``i``'s factors at its TRUE rank — what the serial
@@ -338,6 +342,15 @@ def train_als_grid_bucketed(user_side: BucketedRatings,
             Xc, Yc, lam, alpha, ridge, u_t, i_t,
             **dict(kw, num_iterations=int(n)))
 
+    objective = history = None
+    if _als._train_telemetry_enabled():
+        implicit = bool(base.implicit_prefs)
+        history = []
+
+        def objective(Xc, Yc):
+            return _als._objective_pack_grid(Xc, Yc, lam, alpha, u_t,
+                                             implicit=implicit)
+
     # both branches go through the checkpoint module's grid loop — it
     # owns the per-config finite guard + masking either way (ckpt=None
     # is the single-dispatch fast path)
@@ -346,11 +359,13 @@ def train_als_grid_bucketed(user_side: BucketedRatings,
     X, Y, alive = _checkpoint.run_chunked_grid(
         run_iters, X, Y, int(base.num_iterations), ckpt,
         to_host=lambda a: np.asarray(a, dtype=np.float32),
-        from_host=lambda a: jnp.asarray(a, dtype=fdt))
+        from_host=lambda a: jnp.asarray(a, dtype=fdt),
+        objective=objective, history=history)
     return GridTrainResult(
         user_factors=np.asarray(X, dtype=np.float32),
         item_factors=np.asarray(Y, dtype=np.float32),
-        grid=grid, alive=np.asarray(alive, dtype=bool))
+        grid=grid, alive=np.asarray(alive, dtype=bool),
+        loss_history=history)
 
 
 # ---------------------------------------------------------------------------
@@ -436,7 +451,15 @@ def grid_leaderboard(result: GridTrainResult, train_rows: np.ndarray,
     for i in range(result.grid.k):
         entry = {"config": i,
                  "params": result.grid.describe()[i],
-                 "diverged": not bool(result.alive[i])}
+                 "diverged": not bool(result.alive[i]),
+                 # per-config objective curve (why the winner won):
+                 # one point per telemetry sample this config survived
+                 "lossTrajectory": [
+                     {"step": e["step"], "fit": e["fit"][i],
+                      "l2": e["l2"][i], "total": e["total"][i]}
+                     for e in (result.loss_history or [])
+                     if i < len(e["total"])
+                     and e["total"][i] is not None]}
         if entry["diverged"] or not users:
             entry["metric"] = None
             entry["precisionAtK"] = None

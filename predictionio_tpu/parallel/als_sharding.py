@@ -37,7 +37,11 @@ from predictionio_tpu.ops.als import (
     _als_iterations_impl,
     _als_precision_mode,
     _maybe_checkpointer,
+    _objective_pack,
+    _objective_statics,
     _spd_solver_mode,
+    _train_telemetry_enabled,
+    _uniform_objective_bucket,
     checkpoint_layout_bucketed,
     checkpoint_layout_uniform,
     factor_dtype,
@@ -315,11 +319,23 @@ def _train_sharded(user_side: PaddedRatings, item_side: PaddedRatings,
         from predictionio_tpu.workflow import checkpoint as _checkpoint
 
         fdt = X.dtype
+        objective = None
+        if _train_telemetry_enabled():
+            # same jitted objective program as the single-device lane;
+            # the sharded tables flow through jit and GSPMD inserts the
+            # psum merges (the pack stays one replicated [3] scalar)
+            obj_bucket = _uniform_objective_bucket(u_cols, u_w, u_m, n_u)
+            obj_kw = _objective_statics(params)
+
+            def objective(Xc, Yc):
+                return _objective_pack(Xc, Yc, (obj_bucket,), **obj_kw)
+
         X, Y = _checkpoint.run_chunked(
             run_iters, X, Y, int(params.num_iterations), ckpt,
             to_host=lambda a: np.asarray(a, dtype=np.float32),
             from_host=lambda a: put(jnp.asarray(a, dtype=fdt),
-                                    factor_sharded))
+                                    factor_sharded),
+            objective=objective)
     if not gather:
         # PAlgorithm path: factors STAY sharded in HBM (padded to n_u/n_i
         # rows, bf16 under the bf16 policy); the caller serves from them
@@ -537,10 +553,20 @@ def train_als_bucketed_sharded(user_side: BucketedRatings,
         from predictionio_tpu.workflow import checkpoint as _checkpoint
 
         fdt = X.dtype
+        objective = None
+        if _train_telemetry_enabled():
+            # closure over the PLACED bucket tuples (see _objective_pack:
+            # sharded inputs through the same jitted program)
+            obj_kw = _objective_statics(params)
+
+            def objective(Xc, Yc):
+                return _objective_pack(Xc, Yc, u_t, **obj_kw)
+
         X, Y = _checkpoint.run_chunked(
             run_iters, X, Y, int(params.num_iterations), ckpt,
             to_host=lambda a: np.asarray(a, dtype=np.float32),
-            from_host=lambda a: put(jnp.asarray(a, dtype=fdt), repl))
+            from_host=lambda a: put(jnp.asarray(a, dtype=fdt), repl),
+            objective=objective)
     if not gather:
         # PAlgorithm flavor: factors stay in HBM in their sharded
         # placement (rows padded to the factor divisor, bf16 under the

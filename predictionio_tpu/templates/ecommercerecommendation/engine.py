@@ -192,12 +192,17 @@ class ECommAlgorithm(P2LAlgorithm):
         vals = np.asarray(list(counts.values()), dtype=np.float32)
         rows, cols = keys[:, 0], keys[:, 1]
         n_u, n_i = len(user_map), len(item_map)
+        from predictionio_tpu.workflow import runlog
         from predictionio_tpu.workflow.checkpoint import (
             bimap_fingerprint_scope)
 
         # entity maps join the crash-safe checkpoint fingerprint
-        # (no-op while checkpointing is off)
-        with bimap_fingerprint_scope(user_map, item_map):
+        # (no-op while checkpointing is off); the run-context scope
+        # labels this training's run-history entries
+        with bimap_fingerprint_scope(user_map, item_map), \
+                runlog.run_context_scope(
+                    template="ecommercerecommendation",
+                    nUsers=n_u, nItems=n_i):
             X, Y = _train_als_auto(
                 pad_ratings(rows, cols, vals, n_u, n_i),
                 pad_ratings(cols, rows, vals, n_i, n_u),

@@ -689,14 +689,21 @@ class ALSAlgorithm(_DeviceServingAlgo, P2LAlgorithm):
         # topology-aware: sharded over the (multi-host) mesh when one
         # exists, single-device otherwise (parallel/als_sharding.py)
         from predictionio_tpu.parallel.als_sharding import train_als_auto
+        from predictionio_tpu.workflow import runlog
         from predictionio_tpu.workflow.checkpoint import (
             bimap_fingerprint_scope)
 
         # the entity maps join the crash-safe checkpoint fingerprint:
         # two stores with identical table shapes but different entity
         # universes must never resume each other's checkpoints
-        # (no-op while checkpointing is off)
-        with bimap_fingerprint_scope(pd.user_map, pd.item_map):
+        # (no-op while checkpointing is off); the run-context scope
+        # stamps the run-history header so `pio runs list` can say
+        # WHAT trained, not just when
+        with bimap_fingerprint_scope(pd.user_map, pd.item_map), \
+                runlog.run_context_scope(
+                    template="recommendation",
+                    nUsers=pd.user_side.n_rows,
+                    nItems=pd.user_side.n_cols):
             X, Y = train_als_auto(pd.user_side, pd.item_side, self.params)
         return ALSModel(X, Y, pd.user_map, pd.item_map, pd.seen,
                         item_categories=pd.item_categories)
@@ -766,10 +773,15 @@ class ALSShardedAlgorithm(_DeviceServingAlgo, PAlgorithm):
             density_aware_item_layout,
             train_als_device,
         )
+        from predictionio_tpu.workflow import runlog
         from predictionio_tpu.workflow.checkpoint import (
             bimap_fingerprint_scope)
 
-        with bimap_fingerprint_scope(pd.user_map, pd.item_map):
+        with bimap_fingerprint_scope(pd.user_map, pd.item_map), \
+                runlog.run_context_scope(
+                    template="recommendation-sharded",
+                    nUsers=pd.user_side.n_rows,
+                    nItems=pd.user_side.n_cols):
             X, Y = train_als_device(pd.user_side, pd.item_side,
                                     self.params)
         # serving layout: on a multi-device runtime the item store

@@ -411,6 +411,35 @@ def build_parser() -> argparse.ArgumentParser:
     _add_trace_source(trt)
     tr.set_defaults(func=trace_commands.dispatch)
 
+    from predictionio_tpu.tools import runs_command
+
+    rn = sub.add_parser(
+        "runs",
+        help="training run histories: list recorded runs, render one "
+             "run's loss curve, diff two runs (reads the append-only "
+             "run logs under <checkpoint-dir>/runs/)")
+    rn_sub = rn.add_subparsers(dest="runs_command")
+
+    def _add_runs_dir(p):
+        p.add_argument("--dir", default=None, metavar="DIR",
+                       help="checkpoint directory holding runs/ "
+                            "(default $PIO_CHECKPOINT_DIR)")
+
+    rnl = rn_sub.add_parser("list", help="summarize recorded runs")
+    _add_runs_dir(rnl)
+    rnl.add_argument("-n", type=int, default=20,
+                     help="max runs to show (default 20)")
+    rns = rn_sub.add_parser(
+        "show", help="one run's ASCII loss curve + sample table")
+    rns.add_argument("run_id", help="run id (unique prefixes accepted)")
+    _add_runs_dir(rns)
+    rnc = rn_sub.add_parser(
+        "compare", help="align two runs by step and diff their losses")
+    rnc.add_argument("run_a")
+    rnc.add_argument("run_b")
+    _add_runs_dir(rnc)
+    rn.set_defaults(func=runs_command.dispatch)
+
     from predictionio_tpu.tools import top_command
 
     top = sub.add_parser(

@@ -123,26 +123,29 @@ def _apply_checkpoint_flags(args) -> None:
     preemption: finish the in-flight chunk, write a final checkpoint,
     exit 0."""
     every = getattr(args, "checkpoint_every", None)
-    if every is not None:
-        if every < 1:
-            raise SystemExit("--checkpoint-every must be >= 1")
-        os.environ["PIO_CHECKPOINT_EVERY"] = str(every)
-    cdir = getattr(args, "checkpoint_dir", None)
-    if cdir:
-        os.environ["PIO_CHECKPOINT_DIR"] = cdir
+    if every is not None and every < 1:
+        raise SystemExit("--checkpoint-every must be >= 1")
     keep = getattr(args, "checkpoint_keep", None)
-    if keep is not None:
-        if keep < 1:
-            raise SystemExit("--checkpoint-keep must be >= 1")
-        os.environ["PIO_CHECKPOINT_KEEP"] = str(keep)
-    if getattr(args, "resume", False):
-        os.environ["PIO_RESUME"] = "1"
-    active_dir = os.environ.get("PIO_CHECKPOINT_DIR", "").strip()
-    if (every is not None or getattr(args, "resume", False)) \
-            and not active_dir:
+    if keep is not None and keep < 1:
+        raise SystemExit("--checkpoint-keep must be >= 1")
+    cdir = getattr(args, "checkpoint_dir", None)
+    resume = bool(getattr(args, "resume", False))
+    active_dir = (cdir or os.environ.get("PIO_CHECKPOINT_DIR", "")).strip()
+    if (every is not None or resume) and not active_dir:
         raise SystemExit(
             "--checkpoint-every/--resume require --checkpoint-dir "
             "(or $PIO_CHECKPOINT_DIR)")
+    # validation complete — only now touch the env: a refused
+    # invocation must not leave half the knobs set behind it (in-
+    # process callers would inherit a phantom $PIO_RESUME)
+    if every is not None:
+        os.environ["PIO_CHECKPOINT_EVERY"] = str(every)
+    if cdir:
+        os.environ["PIO_CHECKPOINT_DIR"] = cdir
+    if keep is not None:
+        os.environ["PIO_CHECKPOINT_KEEP"] = str(keep)
+    if resume:
+        os.environ["PIO_RESUME"] = "1"
     # graceful-drain handlers ONLY when a chunk cadence is actually
     # configured here (flag/env every, or --resume): a dir alone runs
     # the single-scan path with no boundary that would ever honor the
@@ -152,12 +155,51 @@ def _apply_checkpoint_flags(args) -> None:
     # checkpoints then land at every boundary and a hard kill stays
     # resumable; only the signal-drain nicety needs the CLI/env knob.)
     if active_dir and (
-            every is not None or getattr(args, "resume", False)
+            every is not None or resume
             or os.environ.get("PIO_CHECKPOINT_EVERY", "").strip()):
         from predictionio_tpu.workflow import checkpoint
 
         checkpoint.clear_stop()
         checkpoint.install_signal_handlers()
+
+
+def _train_progress_scope():
+    """The `pio train` live meter: renders each chunk-boundary
+    telemetry sample as a single ``\\r``-rewritten progress line on
+    stderr. Active when stderr is a TTY, forced on/off with
+    $PIO_TRAIN_PROGRESS; a plain nullcontext under
+    PIO_TRAIN_TELEMETRY=0 (no samples would arrive anyway)."""
+    import contextlib
+
+    from predictionio_tpu.workflow import checkpoint, runlog
+
+    forced = os.environ.get("PIO_TRAIN_PROGRESS", "").strip().lower()
+    if forced in ("0", "false", "no", "off") \
+            or not runlog.telemetry_enabled() \
+            or not (forced in ("1", "true", "yes", "on")
+                    or sys.stderr.isatty()):
+        return contextlib.nullcontext()
+
+    state = {"width": 0}
+
+    def render(p):
+        total = int(p.get("total") or 0)
+        step = int(p.get("step") or 0)
+        bar_w = 24
+        fill = min(bar_w, int(bar_w * step / total)) if total else 0
+        loss = p.get("loss")
+        msg = (f"[{'#' * fill}{'-' * (bar_w - fill)}] "
+               f"iter {step}/{total} "
+               f"loss {'-' if loss is None else f'{loss:.6g}'} "
+               f"({float(p.get('wallSeconds') or 0):.2f}s/chunk)")
+        sys.stderr.write("\r" + msg.ljust(state["width"]))
+        state["width"] = len(msg)
+        if total and step >= total:
+            sys.stderr.write("\n")
+            state["width"] = 0
+        sys.stderr.flush()
+
+    return checkpoint.progress_scope(render)
 
 
 def cmd_train(args) -> int:
@@ -193,7 +235,8 @@ def cmd_train(args) -> int:
         with profile_trace(profile_dir), \
                 trace_scope("pio.train",
                             attributes={"variant": args.engine_variant},
-                            slow_exempt=True):
+                            slow_exempt=True), \
+                _train_progress_scope():
             instance_id = create_workflow(config, variant=variant)
     except TrainingInterruption as e:
         print(f"[INFO] Training interrupted: {e}")
@@ -322,14 +365,25 @@ def _cmd_eval_grid(args) -> int:
           f"{int(grid.base.num_iterations)} iterations on "
           f"{len(tr)} train / {len(held)} held-out interactions "
           f"({len(users)} users, {len(items)} items)")
-    board = wf_tuning.run_grid(
-        user_side, item_side, grid, train_rows=tr, train_cols=tc,
-        held=held, topk=int(getattr(args, "topk", 10) or 10),
-        engine_params_base=ep_base)
-
     from predictionio_tpu.data.storage.localfs import atomic_write_bytes
 
     out = args.grid_out
+
+    def stream_partial(partial_board) -> None:
+        # a killed sweep leaves the latest completed sub-batch's board
+        # on disk — atomic, so readers never see a torn artifact
+        atomic_write_bytes(
+            out, json.dumps(partial_board, indent=2).encode("utf-8"))
+        print(f"[INFO] partial leaderboard "
+              f"({partial_board.get('batchesCompleted')}/"
+              f"{len(partial_board.get('batches') or [])} "
+              f"sub-batches) -> {out}")
+
+    board = wf_tuning.run_grid(
+        user_side, item_side, grid, train_rows=tr, train_cols=tc,
+        held=held, topk=int(getattr(args, "topk", 10) or 10),
+        engine_params_base=ep_base, on_partial=stream_partial)
+
     atomic_write_bytes(out, json.dumps(board, indent=2).encode("utf-8"))
     diverged = [r["config"] for r in board["rows"] if r["diverged"]]
     if diverged:

@@ -680,6 +680,15 @@ TRAIN_CHECKPOINTS = REGISTRY.counter(
     "pio_train_checkpoints_total",
     "Training-checkpoint events by outcome (saved / resumed / "
     "torn_skipped)", ("status",))
+TRAIN_LOSS = REGISTRY.gauge(
+    "pio_train_loss",
+    "Latest on-device training-objective sample by component "
+    "(fit / l2 / total); on the vmapped grid lane the best alive "
+    "config's sample", ("component",))
+TRAIN_CHUNK_SECONDS = REGISTRY.histogram(
+    "pio_train_chunk_seconds",
+    "Wall time of one checkpoint chunk (iteration scan + objective "
+    "sample + checkpoint write)", (), buckets=LONG_BUCKETS)
 
 
 class BoundedLabel:

@@ -71,7 +71,8 @@ import logging
 import os
 import re
 import threading
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+import time as _time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -546,11 +547,211 @@ def chunk_schedule(total: int, every: Optional[int]) -> List[int]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Chunk-boundary telemetry (pure observer)
+#
+# When the trainer hands the loop an ``objective`` closure (the fused
+# [fit, l2, finite] pack from ops/als.py — absent under
+# PIO_TRAIN_TELEMETRY=0), the per-chunk finite guard is upgraded to a
+# graded loss sample: same single D2H scalar transfer, but the abort
+# message can now say WHAT the loss was doing before the NaN, every
+# sample lands in the append-only run log, and the operator surfaces
+# (metrics gauges, train.chunk spans, the live progress meter) light up.
+# The factor math is untouched either way — the purity suite gates
+# byte-identity on/off.
+# ---------------------------------------------------------------------------
+
+# the `pio train` live progress meter binds its renderer here; any
+# other embedder can too. Observer-only: exceptions are swallowed.
+_progress_cb: contextvars.ContextVar[Optional[Callable[[dict], None]]] = \
+    contextvars.ContextVar("pio_train_progress", default=None)
+
+
+@contextlib.contextmanager
+def progress_scope(callback: Callable[[dict], None]):
+    """Bind a per-chunk progress callback (dicts with step/total/loss/
+    wallSeconds/runId) for training runs inside the scope."""
+    token = _progress_cb.set(callback)
+    try:
+        yield
+    finally:
+        _progress_cb.reset(token)
+
+
+def _emit_progress(payload: dict) -> None:
+    cb = _progress_cb.get()
+    if cb is None:
+        return
+    try:
+        cb(payload)
+    except Exception:  # the meter must never kill training
+        logger.debug("progress callback failed", exc_info=True)
+
+
+def _loss_clause(last_loss) -> str:
+    """The divergence message's loss postscript: what the objective was
+    doing at the last finite sample (``(step, fit, l2, total)``)."""
+    if last_loss is None:
+        return "; no finite loss sample was recorded"
+    s, fit, l2, tot = last_loss
+    return (f"; last finite loss total={tot:.6g} (fit={fit:.6g}, "
+            f"l2={l2:.6g}) at iteration {s}")
+
+
+def _open_runlog(ckpt: TrainCheckpointer, step: int, total: int):
+    """The run-history lane for one chunked run: a resume reuses the
+    run id pinned in the manifest it restored (appending to the SAME
+    history, tail-repaired to the resumed step), a fresh run mints one.
+    Returns ``(run_id, RunLog-or-None)`` — telemetry survives a
+    read-only runs/ directory by dropping the log, never the run."""
+    from predictionio_tpu.workflow import runlog as _runlog
+
+    rid = ckpt.resumed_extra.get("runId")
+    run_id = rid if isinstance(rid, str) and rid else _runlog.new_run_id()
+    try:
+        rl = _runlog.RunLog.open(
+            ckpt.directory, run_id, resume_step=step,
+            header={"totalIterations": total,
+                    "checkpointEvery": int(ckpt.every)})
+    except OSError as e:  # pragma: no cover - unwritable runs dir
+        logger.warning("run log unavailable (%s); training continues "
+                       "without run history", e)
+        return run_id, None
+    return run_id, rl
+
+
+def _chunk_sample(rl, step: int, total: int, n: int, loss: Any,
+                  wall_s: float, device_s: Optional[float],
+                  blob_path: Optional[str], extra: Optional[dict] = None
+                  ) -> None:
+    """Append one run-log sample (no-op without a log)."""
+    if rl is None:
+        return
+    from predictionio_tpu.workflow import runlog as _runlog
+
+    ckpt_bytes = None
+    if blob_path is not None:
+        try:
+            ckpt_bytes = os.path.getsize(blob_path)
+        except OSError:
+            pass
+    sample = {
+        "step": int(step), "totalIterations": int(total),
+        "chunkIterations": int(n),
+        "wallSeconds": round(float(wall_s), 6),
+        "deviceSeconds": None if device_s is None
+        else round(float(device_s), 6),
+        "loss": loss,
+        "hbmBytesInUse": _runlog.hbm_bytes_in_use(),
+        "checkpointBytes": ckpt_bytes,
+        "at": _dt.datetime.now(tz=_dt.timezone.utc).isoformat(),
+    }
+    if extra:
+        sample.update(extra)
+    rl.append(sample)
+
+
+def _observe_chunk(rl, run_id: Optional[str], step: int, total: int,
+                   n: int, fit: float, l2: float, wall_s: float,
+                   device_s: Optional[float], blob_path: Optional[str]
+                   ) -> Tuple[int, float, float, float]:
+    """Everything the operator sees from one finite serial chunk:
+    metrics, the ``train.chunk`` span, the run-log sample, the live
+    progress line. Returns the ``(step, fit, l2, total)`` tuple the
+    divergence message quotes as the last finite sample."""
+    from predictionio_tpu.utils import metrics, tracing
+
+    total_loss = fit + l2
+    metrics.TRAIN_LOSS.set(fit, component="fit")
+    metrics.TRAIN_LOSS.set(l2, component="l2")
+    metrics.TRAIN_LOSS.set(total_loss, component="total")
+    metrics.TRAIN_CHUNK_SECONDS.observe(wall_s)
+    end = tracing.span_now()
+    tracing.record_completed_span(
+        "train.chunk", start=end - wall_s, end=end,
+        attributes={"step": int(step), "totalIterations": int(total),
+                    "chunkIterations": int(n), "lossFit": fit,
+                    "lossL2": l2, "lossTotal": total_loss})
+    _chunk_sample(rl, step, total, n,
+                  {"fit": fit, "l2": l2, "total": total_loss},
+                  wall_s, device_s, blob_path)
+    _emit_progress({"step": int(step), "total": int(total),
+                    "loss": total_loss, "fit": fit, "l2": l2,
+                    "wallSeconds": float(wall_s), "runId": run_id})
+    return (int(step), fit, l2, total_loss)
+
+
+def _grid_loss_entry(step: int, pack: np.ndarray, alive: np.ndarray
+                     ) -> dict:
+    """One grid history/run-log sample: per-config component vectors
+    with ``None`` holes for dead configs."""
+    fit: List[Optional[float]] = []
+    l2: List[Optional[float]] = []
+    tot: List[Optional[float]] = []
+    for i, ok in enumerate(alive):
+        if ok:
+            fit.append(float(pack[i, 0]))
+            l2.append(float(pack[i, 1]))
+            tot.append(float(pack[i, 0] + pack[i, 1]))
+        else:
+            fit.append(None)
+            l2.append(None)
+            tot.append(None)
+    return {"step": int(step), "fit": fit, "l2": l2, "total": tot}
+
+
+def _observe_grid_chunk(rl, run_id: Optional[str], step: int, total: int,
+                        n: int, entry: dict, alive: np.ndarray,
+                        wall_s: float, device_s: Optional[float],
+                        blob_path: Optional[str]) -> None:
+    """Grid analog of :func:`_observe_chunk`: the gauges track the best
+    (lowest-total) alive config; the span and run-log sample carry the
+    full per-config vectors."""
+    from predictionio_tpu.utils import metrics, tracing
+
+    best = None
+    for i, t in enumerate(entry["total"]):
+        if t is not None and (best is None or t < entry["total"][best]):
+            best = i
+    if best is not None:
+        metrics.TRAIN_LOSS.set(entry["fit"][best], component="fit")
+        metrics.TRAIN_LOSS.set(entry["l2"][best], component="l2")
+        metrics.TRAIN_LOSS.set(entry["total"][best], component="total")
+    metrics.TRAIN_CHUNK_SECONDS.observe(wall_s)
+    end = tracing.span_now()
+    tracing.record_completed_span(
+        "train.chunk", start=end - wall_s, end=end,
+        attributes={"step": int(step), "totalIterations": int(total),
+                    "chunkIterations": int(n),
+                    "aliveConfigs": int(np.count_nonzero(alive)),
+                    "bestConfig": best,
+                    "lossTotal": None if best is None
+                    else entry["total"][best]})
+    _chunk_sample(rl, step, total, n,
+                  {"fit": entry["fit"], "l2": entry["l2"],
+                   "total": entry["total"]},
+                  wall_s, device_s, blob_path,
+                  extra={"aliveConfigs": [bool(a) for a in alive]})
+    _emit_progress({"step": int(step), "total": int(total),
+                    "loss": None if best is None
+                    else entry["total"][best],
+                    "aliveConfigs": int(np.count_nonzero(alive)),
+                    "wallSeconds": float(wall_s), "runId": run_id})
+
+
+def _grid_deaths(died_step: Dict[int, int]) -> str:
+    """The all-dead abort's roster: exactly which config indices died,
+    and when (satellite: today's message is contextless)."""
+    return ", ".join(f"config {i} at iteration {died_step[i]}"
+                     for i in sorted(died_step))
+
+
 def run_chunked(run_iters: Callable[[Any, Any, int], Tuple[Any, Any]],
                 X: Any, Y: Any, total_iterations: int,
                 ckpt: Optional[TrainCheckpointer], *,
                 to_host: Callable[[Any], np.ndarray],
-                from_host: Callable[[np.ndarray], Any]
+                from_host: Callable[[np.ndarray], Any],
+                objective: Optional[Callable[[Any, Any], Any]] = None
                 ) -> Tuple[Any, Any]:
     """Drive ``run_iters(X, Y, n) -> (X, Y)`` (a jitted iteration
     program with a STATIC trip count) through the checkpoint lifecycle.
@@ -563,7 +764,12 @@ def run_chunked(run_iters: Callable[[Any, Any, int], Tuple[Any, Any]],
     and honor the preemption flag. ``to_host``/``from_host`` are the
     caller's placement policy (plain ``np.asarray`` fp32 / a
     dtype-and-sharding-preserving put), so uniform, bucketed and
-    single-host sharded trainers all share this one driver."""
+    single-host sharded trainers all share this one driver.
+
+    ``objective`` (when telemetry is on) returns the fused
+    ``[fit, l2, finite]`` pack for the current carries; it replaces the
+    boolean finite guard with a graded one and feeds the run log,
+    metrics, spans and progress meter — observer-only by contract."""
     total = int(total_iterations)
     if ckpt is None:
         return run_iters(X, Y, total)
@@ -590,24 +796,55 @@ def run_chunked(run_iters: Callable[[Any, Any, int], Tuple[Any, Any]],
                 "(different mesh/padding topology); refusing to "
                 "resume")
         X, Y = from_host(Xh), from_host(Yh)
-    for n in chunk_schedule(total - step, ckpt.every):
-        X, Y = run_iters(X, Y, int(n))
-        step += n
-        # on-device finite guard: one scalar reduction per chunk; a
-        # diverged state is never checkpointed, so the last intact
-        # checkpoint survives for post-mortem/restart
-        if not _factors_finite(X, Y):
-            metrics.TRAIN_DIVERGED.inc()
-            raise TrainingDivergedError(
-                f"non-finite factors after iteration {step}/{total}; "
-                f"aborting (last intact checkpoint retained in "
-                f"{ckpt.directory})")
-        ckpt.save(step, to_host(X), to_host(Y))
-        if step < total and stop_requested():
-            raise TrainingPreempted(
-                f"stop requested: checkpoint saved at iteration "
-                f"{step}/{total} in {ckpt.directory}; resume with "
-                f"pio train --resume")
+    rl = run_id = extra = None
+    last_loss = None  # (step, fit, l2, total) of the newest finite sample
+    if objective is not None:
+        run_id, rl = _open_runlog(ckpt, step, total)
+        extra = {"runId": run_id}
+    try:
+        for n in chunk_schedule(total - step, ckpt.every):
+            t0 = _time.perf_counter()
+            X, Y = run_iters(X, Y, int(n))
+            pack = device_s = None
+            if objective is not None:
+                # graded guard: the objective pack fuses the finite
+                # reduction with the loss — still ONE program and one
+                # scalar D2H per chunk. Block first so deviceSeconds
+                # is the chunk's compute window alone.
+                import jax
+
+                jax.block_until_ready((X, Y))
+                device_s = _time.perf_counter() - t0
+                pack = np.asarray(objective(X, Y), dtype=np.float64)
+                finite_ok = bool(pack[2] == 1.0)
+            else:
+                # on-device finite guard: one scalar reduction per chunk
+                finite_ok = _factors_finite(X, Y)
+            step += n
+            # a diverged state is never checkpointed, so the last
+            # intact checkpoint survives for post-mortem/restart
+            if not finite_ok:
+                metrics.TRAIN_DIVERGED.inc()
+                raise TrainingDivergedError(
+                    f"non-finite factors after iteration {step}/{total} "
+                    f"(the chunk of {int(n)} iterations ending there); "
+                    f"aborting (last intact checkpoint retained in "
+                    f"{ckpt.directory})" + _loss_clause(last_loss))
+            blob_path = ckpt.save(step, to_host(X), to_host(Y),
+                                  extra=extra)
+            if pack is not None:
+                last_loss = _observe_chunk(
+                    rl, run_id, step, total, int(n),
+                    float(pack[0]), float(pack[1]),
+                    _time.perf_counter() - t0, device_s, blob_path)
+            if step < total and stop_requested():
+                raise TrainingPreempted(
+                    f"stop requested: checkpoint saved at iteration "
+                    f"{step}/{total} in {ckpt.directory}; resume with "
+                    f"pio train --resume")
+    finally:
+        if rl is not None:
+            rl.close()
     return X, Y
 
 
@@ -663,7 +900,9 @@ def run_chunked_grid(run_iters: Callable[[Any, Any, int],
                      X: Any, Y: Any, total_iterations: int,
                      ckpt: Optional[TrainCheckpointer], *,
                      to_host: Callable[[Any], np.ndarray],
-                     from_host: Callable[[np.ndarray], Any]
+                     from_host: Callable[[np.ndarray], Any],
+                     objective: Optional[Callable[[Any, Any], Any]] = None,
+                     history: Optional[List[dict]] = None
                      ) -> Tuple[Any, Any, np.ndarray]:
     """:func:`run_chunked` for the vmapped config grid: the factor
     carries are stacked ``[k, ...]`` and divergence is PER-CONFIG — a
@@ -672,21 +911,36 @@ def run_chunked_grid(run_iters: Callable[[Any, Any, int],
     training; the whole run aborts only when EVERY config is dead. The
     alive mask rides the checkpoint manifest's ``extra`` block, so
     resume-mid-grid does not resurrect a masked config. Returns
-    ``(X, Y, alive)`` with ``alive`` a host ``[k]`` bool vector."""
+    ``(X, Y, alive)`` with ``alive`` a host ``[k]`` bool vector.
+
+    ``objective`` returns the per-config ``[k, 3]`` loss pack (the
+    graded guard); finite samples append to ``history`` (the
+    leaderboard's per-config loss trajectories) and the run log. The
+    checkpointed lane samples every chunk; without a checkpointer one
+    end-of-run sample still grades the result."""
     from predictionio_tpu.utils import metrics
 
     total = int(total_iterations)
     k = int(np.shape(X)[0])
     alive = np.ones(k, dtype=bool)
+    died_step: Dict[int, int] = {}
+    last_totals: List[Optional[float]] = [None] * k
 
-    def guard_and_mask(X, Y, alive, step):
-        finite = _grid_factors_finite(X, Y)
+    def guard_and_mask(X, Y, alive, step, finite=None):
+        if finite is None:
+            finite = _grid_factors_finite(X, Y)
+        finite = np.asarray(finite, dtype=bool)
         newly_dead = alive & ~finite
         for idx in np.flatnonzero(newly_dead):
+            idx = int(idx)
+            died_step[idx] = int(step)
+            lt = last_totals[idx]
             logger.warning(
-                "grid config %d diverged after iteration %d/%d; "
+                "grid config %d diverged after iteration %d/%d%s; "
                 "masking it out (factors zeroed, neighbors "
-                "unaffected)", int(idx), step, total)
+                "unaffected)", idx, step, total,
+                "" if lt is None
+                else f" (last finite loss total={lt:.6g})")
             metrics.TRAIN_DIVERGED.inc()
         alive = alive & finite
         if not alive.all():
@@ -698,11 +952,20 @@ def run_chunked_grid(run_iters: Callable[[Any, Any, int],
 
     if ckpt is None:
         X, Y = run_iters(X, Y, total)
-        X, Y, alive = guard_and_mask(X, Y, alive, total)
+        pack = None
+        if objective is not None:
+            pack = np.asarray(objective(X, Y), dtype=np.float64)
+            X, Y, alive = guard_and_mask(X, Y, alive, total,
+                                         pack[:, 2] == 1.0)
+        else:
+            X, Y, alive = guard_and_mask(X, Y, alive, total)
         if not alive.any():
             raise TrainingDivergedError(
                 f"every grid config diverged within {total} "
-                "iterations; nothing to return")
+                f"iterations ({_grid_deaths(died_step)}); nothing "
+                "to return")
+        if pack is not None and history is not None:
+            history.append(_grid_loss_entry(total, pack, alive))
         return X, Y, alive
 
     step = 0
@@ -728,20 +991,52 @@ def run_chunked_grid(run_iters: Callable[[Any, Any, int],
             # re-apply the mask: the blob already carries zeros for
             # dead lanes, but from_host may have round-tripped dtype
             X, Y = _mask_dead_configs(X, Y, alive)
-    for n in chunk_schedule(total - step, ckpt.every):
-        X, Y = run_iters(X, Y, int(n))
-        step += n
-        X, Y, alive = guard_and_mask(X, Y, alive, step)
-        if not alive.any():
-            raise TrainingDivergedError(
-                f"every grid config diverged by iteration {step}/"
-                f"{total}; aborting (last intact checkpoint retained "
-                f"in {ckpt.directory})")
-        ckpt.save(step, to_host(X), to_host(Y),
-                  extra={"aliveConfigs": [bool(a) for a in alive],
-                         "gridK": k})
-        if step < total and stop_requested():
-            raise TrainingPreempted(
-                f"stop requested: grid checkpoint saved at iteration "
-                f"{step}/{total} in {ckpt.directory}; rerun to resume")
+    rl = run_id = None
+    if objective is not None:
+        run_id, rl = _open_runlog(ckpt, step, total)
+    try:
+        for n in chunk_schedule(total - step, ckpt.every):
+            t0 = _time.perf_counter()
+            X, Y = run_iters(X, Y, int(n))
+            pack = device_s = finite = None
+            if objective is not None:
+                import jax
+
+                jax.block_until_ready((X, Y))
+                device_s = _time.perf_counter() - t0
+                pack = np.asarray(objective(X, Y), dtype=np.float64)
+                finite = pack[:, 2] == 1.0
+            step += n
+            X, Y, alive = guard_and_mask(X, Y, alive, step, finite)
+            if not alive.any():
+                raise TrainingDivergedError(
+                    f"every grid config diverged by iteration {step}/"
+                    f"{total} ({_grid_deaths(died_step)}); aborting "
+                    f"(last intact checkpoint retained in "
+                    f"{ckpt.directory})")
+            extra = {"aliveConfigs": [bool(a) for a in alive],
+                     "gridK": k}
+            if run_id is not None:
+                extra["runId"] = run_id
+            blob_path = ckpt.save(step, to_host(X), to_host(Y),
+                                  extra=extra)
+            if pack is not None:
+                entry = _grid_loss_entry(step, pack, alive)
+                if history is not None:
+                    history.append(entry)
+                for i, t in enumerate(entry["total"]):
+                    if t is not None:
+                        last_totals[i] = t
+                _observe_grid_chunk(rl, run_id, step, total, int(n),
+                                    entry, alive,
+                                    _time.perf_counter() - t0,
+                                    device_s, blob_path)
+            if step < total and stop_requested():
+                raise TrainingPreempted(
+                    f"stop requested: grid checkpoint saved at "
+                    f"iteration {step}/{total} in {ckpt.directory}; "
+                    f"rerun to resume")
+    finally:
+        if rl is not None:
+            rl.close()
     return X, Y, alive

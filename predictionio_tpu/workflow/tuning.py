@@ -129,12 +129,20 @@ def run_grid(user_side, item_side, grid: ConfigGrid, *,
              budget_bytes: Optional[int] = None,
              reports: Sequence[Mapping] = (),
              engine_params_base=None, algo_name: str = "als",
-             warmup: bool = True) -> Dict[str, Any]:
+             warmup: bool = True,
+             on_partial=None) -> Dict[str, Any]:
     """Train the whole grid (sub-batched to the HBM budget), evaluate
     every config on device, and return the leaderboard artifact:
     ``rows`` best-first, ``winner`` pinned with its full EngineParams
     (when ``engine_params_base`` is given), plus the schedule the
-    batches actually ran under."""
+    batches actually ran under.
+
+    ``on_partial`` (when given) receives an intermediate leaderboard
+    after every completed sub-batch except the last — rows whose
+    configs haven't trained yet carry ``pending: True`` and the board
+    ``partial: True`` — so a killed sweep leaves a usable artifact
+    (``pio eval --grid`` streams these through ``atomic_write_bytes``).
+    Callback failures are logged, never fatal."""
     n_users, n_items = user_side.n_rows, item_side.n_rows
     if budget_bytes is None:
         budget_bytes = hbm_budget_bytes(reports)
@@ -144,7 +152,46 @@ def run_grid(user_side, item_side, grid: ConfigGrid, *,
     uf = np.zeros((grid.k, n_users, r_max), np.float32)
     itf = np.zeros((grid.k, n_items, r_max), np.float32)
     alive = np.zeros(grid.k, dtype=bool)
-    for batch in batches:
+    trained: set = set()
+    # sub-batch loss histories merged by step into full-k vectors (the
+    # chunk schedule is shared, so steps align across batches); configs
+    # from batches that never sampled stay None holes
+    merged_history: Dict[int, dict] = {}
+
+    def _merge_history(batch, hist):
+        for e in hist or ():
+            m = merged_history.setdefault(
+                int(e["step"]), {"step": int(e["step"]),
+                                 "fit": [None] * grid.k,
+                                 "l2": [None] * grid.k,
+                                 "total": [None] * grid.k})
+            for j, i in enumerate(batch):
+                m["fit"][i] = e["fit"][j]
+                m["l2"][i] = e["l2"][j]
+                m["total"][i] = e["total"][j]
+
+    def _make_board(partial: bool, done: int) -> Dict[str, Any]:
+        merged = GridTrainResult(
+            user_factors=uf, item_factors=itf, grid=grid, alive=alive,
+            loss_history=[merged_history[s]
+                          for s in sorted(merged_history)] or None)
+        board = _tuning.grid_leaderboard(merged, train_rows, train_cols,
+                                         held, topk=topk)
+        board["gridK"] = grid.k
+        board["batches"] = [len(b) for b in batches]
+        board["hbmBudgetBytes"] = budget_bytes
+        if partial:
+            board["partial"] = True
+            board["batchesCompleted"] = int(done)
+            for row in board["rows"]:
+                if row["config"] not in trained:
+                    # zero factors read as "diverged" to the scorer;
+                    # an untrained config is pending, not dead
+                    row["pending"] = True
+                    row["diverged"] = False
+        return board
+
+    for bi, batch in enumerate(batches):
         sub = grid.subset(batch)
         if warmup:
             _als.warmup_train_als_bucketed(user_side, item_side, sub)
@@ -159,19 +206,22 @@ def run_grid(user_side, item_side, grid: ConfigGrid, *,
             logger.warning(
                 "grid sub-batch %s diverged entirely (%s); its configs "
                 "are marked dead, remaining batches continue", batch, e)
-            continue
-        for j, i in enumerate(batch):
-            r = int(sub.configs[j].rank)
-            uf[i, :, :r] = res.user_factors[j][:, :r]
-            itf[i, :, :r] = res.item_factors[j][:, :r]
-            alive[i] = res.alive[j]
-    merged = GridTrainResult(user_factors=uf, item_factors=itf,
-                             grid=grid, alive=alive)
-    board = _tuning.grid_leaderboard(merged, train_rows, train_cols,
-                                     held, topk=topk)
-    board["gridK"] = grid.k
-    board["batches"] = [len(b) for b in batches]
-    board["hbmBudgetBytes"] = budget_bytes
+            res = None
+        if res is not None:
+            for j, i in enumerate(batch):
+                r = int(sub.configs[j].rank)
+                uf[i, :, :r] = res.user_factors[j][:, :r]
+                itf[i, :, :r] = res.item_factors[j][:, :r]
+                alive[i] = res.alive[j]
+            _merge_history(batch, res.loss_history)
+        trained.update(int(i) for i in batch)
+        if on_partial is not None and bi < len(batches) - 1:
+            try:
+                on_partial(_make_board(partial=True, done=bi + 1))
+            except Exception:
+                logger.warning("on_partial leaderboard callback failed",
+                               exc_info=True)
+    board = _make_board(partial=False, done=len(batches))
     if board["winner"] is not None and engine_params_base is not None:
         from predictionio_tpu.controller.engine import (
             expand_engine_params,

@@ -341,7 +341,8 @@ class TestTornRecovery:
             ckpt_env, monkeypatch)
         for f in os.listdir(ckpt_env):
             p = ckpt_env / f
-            p.write_bytes(p.read_bytes()[:10])
+            if p.is_file():  # skip the runs/ history subdir
+                p.write_bytes(p.read_bytes()[:10])
         monkeypatch.setenv("PIO_RESUME", "1")
         X1, Y1 = train_als(us, its, PARAMS)
         assert np.array_equal(X0, X1) and np.array_equal(Y0, Y1)
@@ -459,12 +460,14 @@ class TestDivergenceGuard:
         user_side, item_side = make_uniform()
         train_als(user_side, item_side, PARAMS)
         kept = {f: (ckpt_env / f).read_bytes()
-                for f in os.listdir(ckpt_env)}
+                for f in os.listdir(ckpt_env)
+                if (ckpt_env / f).is_file()}  # runs/ is history, not ckpt
         us, its = self._nan_sides()
         with pytest.raises(TrainingDivergedError):
             train_als(us, its, PARAMS)
         assert {f: (ckpt_env / f).read_bytes()
-                for f in os.listdir(ckpt_env)} == kept
+                for f in os.listdir(ckpt_env)
+                if (ckpt_env / f).is_file()} == kept
 
     def test_no_guard_cost_when_off(self, monkeypatch):
         # without a checkpoint dir the single-scan path runs untouched
@@ -610,7 +613,9 @@ class TestCLIFlags:
         from predictionio_tpu.tools.run_commands import (
             _apply_checkpoint_flags)
 
-        monkeypatch.delenv("PIO_CHECKPOINT_DIR", raising=False)
+        for var in ("PIO_CHECKPOINT_EVERY", "PIO_CHECKPOINT_DIR",
+                    "PIO_RESUME"):
+            monkeypatch.delenv(var, raising=False)
         with pytest.raises(SystemExit):
             _apply_checkpoint_flags(self._args(checkpoint_every=3))
         with pytest.raises(SystemExit):
@@ -618,6 +623,13 @@ class TestCLIFlags:
         with pytest.raises(SystemExit):
             _apply_checkpoint_flags(self._args(
                 checkpoint_every=0, checkpoint_dir="/tmp/x"))
+        # a refused invocation must not half-apply: it used to leave
+        # $PIO_RESUME/$PIO_CHECKPOINT_EVERY behind in the REAL environ,
+        # silently turning every later in-process training into a
+        # resume (this test has no environ sandbox on purpose)
+        for var in ("PIO_CHECKPOINT_EVERY", "PIO_CHECKPOINT_DIR",
+                    "PIO_RESUME"):
+            assert var not in os.environ
 
     def test_dir_alone_installs_no_handlers(self, tmp_path,
                                             monkeypatch):
