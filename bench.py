@@ -1367,6 +1367,7 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
                        serve_precision: Optional[str] = None,
                        serve_kernel: Optional[str] = None,
                        serve_shards: Optional[int] = None,
+                       fleet: Optional[int] = None,
                        template: str = "recommendation") -> dict:
     """Closed-loop HTTP load generator against a DEPLOYED query server
     — the PR-10 continuous-batching acceptance bench (ROADMAP item 2:
@@ -1399,7 +1400,11 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
     sharded lane: the deployed store density-shards over that many
     devices (clamped to what the host has — the artifact stamps the
     REAL shard count) and every query runs per-shard top-k + on-device
-    merge, zero-compile gate unchanged."""
+    merge, zero-compile gate unchanged. ``fleet`` runs the PR-18
+    query-fleet lane: that many replicas behind the keep-alive
+    balancer, the same closed-loop sweep through its user-sticky
+    routing, plus a rolling warm ``/reload`` fired UNDER load whose
+    gate is zero failed queries (the fleet is never cold)."""
     import datetime as _dt
     import http.client
     import os
@@ -1509,8 +1514,14 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
 
         metrics.install_jit_compile_listener()
         t0 = time.perf_counter()
-        srv = QueryServer(ServerConfig(ip="127.0.0.1", port=0)).start(
-            undeploy_stale=False)
+        if fleet is not None and int(fleet) > 1:
+            from predictionio_tpu.fleet.balancer import QueryFleet
+            srv = QueryFleet(ServerConfig(ip="127.0.0.1", port=0),
+                             replicas=int(fleet)).start(
+                undeploy_stale=False)
+        else:
+            srv = QueryServer(ServerConfig(ip="127.0.0.1", port=0)).start(
+                undeploy_stale=False)
         deploy_sec = time.perf_counter() - t0  # includes the AOT ladder
         host, port = srv.address
 
@@ -1599,6 +1610,50 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
         sweep = [run_level(q, duration_sec) for q in levels]
         jit_delta = metrics.JIT_COMPILES.value() - compiles0
 
+        fleet_report = None
+        if fleet is not None and int(fleet) > 1:
+            # rolling warm /reload fired while the closed loop is still
+            # hammering: the balancer drains each replica, swaps it,
+            # rejoins — the acceptance gate is ZERO failed queries while
+            # every replica exchanges its engine instance underneath
+            reload_out: dict = {}
+
+            def _reload_worker() -> None:
+                time.sleep(max(0.2, duration_sec * 0.25))
+                conn = http.client.HTTPConnection(host, port, timeout=120)
+                try:
+                    conn.request("POST", "/reload")
+                    resp = conn.getresponse()
+                    payload = json.loads(
+                        resp.read().decode("utf-8") or "{}")
+                    reload_out.update(
+                        {"status": resp.status,
+                         "replicas_swapped": len(
+                             payload.get("replicas") or [])})
+                except Exception as e:  # surfaced in the artifact
+                    reload_out.update({"status": None, "error": repr(e)})
+                finally:
+                    conn.close()
+
+            th = threading.Thread(target=_reload_worker)
+            th.start()
+            reload_level = run_level(levels[0], duration_sec)
+            th.join()
+            topo = srv.topology()
+            fleet_report = {
+                "replicas": int(fleet),
+                "ready_replicas": topo["readyReplicas"],
+                "reload_status": reload_out.get("status"),
+                "reload_replicas_swapped": reload_out.get(
+                    "replicas_swapped"),
+                "reload_under_load": reload_level,
+                "gate_warm_reload_zero_errors": bool(
+                    reload_out.get("status") == 200
+                    and reload_out.get("replicas_swapped") == int(fleet)
+                    and reload_level["errors"] == 0
+                    and topo["readyReplicas"] == int(fleet)),
+            }
+
         sustainable = None
         for lv in sweep:
             ok = (lv["queries"] > 0
@@ -1639,6 +1694,8 @@ def serving_load_bench(n_users: int = 256, n_items: int = 128,
             "serve_kernel": serve_kernel or "auto",
             "serve_shards_requested": serve_shards,
             "serve_shards": shard_counts[-1],
+            "fleet_replicas": int(fleet) if fleet else 1,
+            "fleet": fleet_report,
             "deploy_warmup_sec": round(deploy_sec, 2),
             "levels": sweep,
             "max_sustainable_qps": None if sustainable is None
@@ -2407,6 +2464,357 @@ def chaos_serving_bench(n_users: int = 128, n_items: int = 96,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# bootstrap for ONE fleet_ingest_bench shard: a real event server in
+# its OWN process (in-process shards would share the parent's GIL and
+# the bench would measure thread scheduling, not ingest scaling)
+_FLEET_SHARD_BOOT = r"""
+import threading
+from predictionio_tpu.data import storage as storage_mod
+from predictionio_tpu.data.api.event_server import (
+    EventServer, EventServerConfig)
+reg = storage_mod.StorageRegistry(storage_mod.StorageConfig(
+    sources={"EV": {"type": "memory"}, "META": {"type": "memory"}},
+    repositories={"EVENTDATA": "EV", "METADATA": "META",
+                  "MODELDATA": "META"}))
+srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0,
+                                    service_key="bench"), reg=reg).start()
+print("READY %d" % srv.address[1], flush=True)
+threading.Event().wait()
+"""
+
+# bootstrap for ONE ingest worker: builds its own FleetLEvents router
+# (so the consistent-hash fan-out itself is part of the measured path),
+# pre-generates its event slice, then waits for GO so every worker's
+# timed window starts together
+_FLEET_WORKER_BOOT = r"""
+import datetime as dt
+import random
+import sys
+import time
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.fleet.router import FleetLEvents
+urls, seed, count, batch, app = (sys.argv[1], int(sys.argv[2]),
+                                 int(sys.argv[3]), int(sys.argv[4]),
+                                 int(sys.argv[5]))
+rng = random.Random(seed)
+t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+events = [Event(event="rate", entity_type="user",
+                entity_id="u%d" % rng.randrange(4096),
+                target_entity_type="item",
+                target_entity_id="i%d" % rng.randrange(512),
+                properties={"rating": float(rng.randint(1, 5))},
+                event_time=t0 + dt.timedelta(seconds=i),
+                event_id=new_event_id())
+          for i in range(count)]
+fleet = FleetLEvents({"urls": urls, "service_key": "bench"})
+print("READY", flush=True)
+sys.stdin.readline()  # GO barrier
+start = time.perf_counter()
+for lo in range(0, count, batch):
+    fleet.insert_batch(events[lo:lo + batch], app)
+print("DONE %.6f" % (time.perf_counter() - start), flush=True)
+fleet.close()
+"""
+
+
+def fleet_ingest_bench(n_events: int = 6000, workers: int = 4,
+                       batch: int = 200,
+                       shard_counts: tuple = (1, 4),
+                       seed: int = 31) -> dict:
+    """PR-18 sharded host plane: ingest QPS of the consistent-hash
+    event-store fleet at 1 shard vs 4 shards.
+
+    Every shard is a REAL event server in its own subprocess (separate
+    GIL — the whole point: one Python event server saturates one core
+    on HTTP parse + event decode + insert, so capacity must come from
+    more processes). The ingest side is ``workers`` client subprocesses,
+    each running the actual ``FleetLEvents`` router over the same URL
+    list — the ring hash, per-shard batching and parallel fan-out are
+    all inside the timed window. Workers pre-build their event slice,
+    then a GO barrier starts every timed window together; the fleet
+    rate is total events over the slowest worker's wall.
+
+    The acceptance gate is >= 3x scaling at 4 shards: anything near 1x
+    would mean the router serialized what the ring was meant to spread.
+    Like the device-side QPS gates, the scaling gate ARMS on a host
+    with >= 4 usable cores (the bench host) — a 1-core container can
+    only prove the wiring (exactly-once counts through the scatter
+    path) and report the measured ratio, stamped with ``host_cores`` so
+    the artifact says which kind of run it was."""
+    import os as _os
+    import subprocess
+    import sys as _sys
+
+    app_id = 1
+    per_worker = -(-n_events // workers)  # ceil
+    total = per_worker * workers
+
+    def _spawn_shards(n: int) -> tuple:
+        procs, urls = [], []
+        for _ in range(n):
+            p = subprocess.Popen(
+                [_sys.executable, "-c", _FLEET_SHARD_BOOT],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True)
+            procs.append(p)
+        for p in procs:
+            line = p.stdout.readline()
+            if not line.startswith("READY"):
+                raise RuntimeError(f"shard failed to boot: {line!r}")
+            urls.append(f"http://127.0.0.1:{int(line.split()[1])}")
+        return procs, urls
+
+    def _run(n_shards: int) -> dict:
+        shard_procs, urls = _spawn_shards(n_shards)
+        worker_procs = []
+        try:
+            from predictionio_tpu.fleet.router import FleetLEvents
+            admin = FleetLEvents({"urls": ",".join(urls),
+                                  "service_key": "bench"})
+            try:
+                admin.init(app_id)
+                for w in range(workers):
+                    worker_procs.append(subprocess.Popen(
+                        [_sys.executable, "-c", _FLEET_WORKER_BOOT,
+                         ",".join(urls), str(seed + w), str(per_worker),
+                         str(batch), str(app_id)],
+                        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                        stderr=subprocess.DEVNULL, text=True))
+                for p in worker_procs:
+                    if not p.stdout.readline().startswith("READY"):
+                        raise RuntimeError("ingest worker failed to boot")
+                for p in worker_procs:  # GO barrier
+                    p.stdin.write("GO\n")
+                    p.stdin.flush()
+                walls = []
+                for p in worker_procs:
+                    line = p.stdout.readline()
+                    if not line.startswith("DONE"):
+                        raise RuntimeError(
+                            f"ingest worker died mid-run: {line!r}")
+                    walls.append(float(line.split()[1]))
+                # exactly-once check: the fleet must hold every event
+                stored = sum(1 for _ in admin.find(app_id))
+                wall = max(walls)
+                return {"shards": n_shards,
+                        "events": total,
+                        "stored": stored,
+                        "wall_sec": round(wall, 3),
+                        "events_per_sec": round(total / wall, 1),
+                        "verified": stored == total}
+            finally:
+                admin.close()
+        finally:
+            for p in worker_procs + shard_procs:
+                p.kill()
+            for p in worker_procs + shard_procs:
+                p.wait()
+
+    runs = {str(n): _run(n) for n in shard_counts}
+    base = runs[str(min(shard_counts))]
+    top = runs[str(max(shard_counts))]
+    speedup = top["events_per_sec"] / base["events_per_sec"]
+    try:
+        cores = len(_os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        cores = _os.cpu_count() or 1
+    return {
+        "events": total,
+        "ingest_workers": workers,
+        "batch": batch,
+        "host_cores": cores,
+        "per_shard_count": runs,
+        "speedup": round(speedup, 2),
+        "wiring_gate": bool(base["verified"] and top["verified"]),
+        # scaling is MULTI-CORE event-server capacity: on fewer cores
+        # than shards the processes time-slice one CPU and the ratio
+        # measures the scheduler, so the gate is not-applicable (None)
+        # there — same contract as the device-only QPS gates
+        "scaling_gate_3x": None if cores < max(shard_counts)
+        else bool(speedup >= 3.0 and base["verified"]
+                  and top["verified"]),
+        "note": ("subprocess shards + subprocess FleetLEvents ingest "
+                 "workers: scaling is real multi-core event-server "
+                 "capacity through the consistent-hash router, not "
+                 "thread interleaving; 'wiring_gate' is the exactly-"
+                 "once count read back through the scatter path; the "
+                 "3x gate arms on a >=4-core host"),
+    }
+
+
+def fleet_chaos_serving_bench(n_users: int = 96, n_items: int = 64,
+                              rank: int = 8, n_queries: int = 200,
+                              shards: int = 3, seed: int = 7) -> dict:
+    """PR-18 dead-shard degradation: the e-commerce predict path served
+    out of the ``fleet`` STORAGE SOURCE TYPE (EVENTDATA routed through
+    the consistent-hash router over live in-process event-server
+    shards), with the shard owning ``constraint/unavailableItems``
+    killed mid-run.
+
+    Every query does three live constraint reads; the unavailable-items
+    read lands on the dead shard every time, so the acceptance gate is
+    the sharpest possible: 100% of queries answer degraded
+    (``shard_down``), 0% fail. The healthy lane first proves the same
+    fleet serves clean when all shards are up."""
+    import logging as _logging
+
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import StorageConfig
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.storage.observed import unwrap
+    from predictionio_tpu.fleet.router import entity_key
+    from predictionio_tpu.templates.ecommercerecommendation.engine import (
+        ECommAlgorithm,
+        ECommAlgorithmParams,
+        ECommModel,
+        Item,
+        Query,
+    )
+    from predictionio_tpu.utils import faults, resilience
+
+    import datetime as _dt
+
+    rng = np.random.default_rng(seed)
+    t0_evt = _dt.datetime(2024, 1, 1, tzinfo=_dt.timezone.utc)
+    faults.clear()
+    resilience.reset_breakers()
+    prior_enabled = resilience.enabled()
+    resilience.set_enabled(True)
+    quiet = [_logging.getLogger("pio.templates.ecommerce"),
+             _logging.getLogger("pio.resilience"),
+             _logging.getLogger("pio.storage.resthttp"),
+             _logging.getLogger("pio.fleet.router")]
+    prior_levels = [lg.level for lg in quiet]
+    servers = []
+    try:
+        for lg in quiet:
+            lg.setLevel(_logging.CRITICAL)
+        for _ in range(shards):
+            servers.append(EventServer(
+                EventServerConfig(ip="127.0.0.1", port=0,
+                                  service_key="chaos"),
+                reg=storage_mod.StorageRegistry(StorageConfig(
+                    sources={"EV": {"type": "memory"},
+                             "META": {"type": "memory"}},
+                    repositories={"EVENTDATA": "EV", "METADATA": "META",
+                                  "MODELDATA": "META"}))).start())
+        urls = ",".join(f"http://{h}:{p}"
+                        for h, p in (s.address for s in servers))
+        # EVENTDATA is the REGISTERED fleet source type — the same
+        # config an operator writes; everything below it goes through
+        # the router
+        storage_mod.reset(StorageConfig(
+            sources={"FLEET": {"type": "fleet", "urls": urls,
+                               "service_key": "chaos"},
+                     "META": {"type": "memory"}},
+            repositories={"EVENTDATA": "FLEET", "METADATA": "META",
+                          "MODELDATA": "META"}))
+        aid = storage_mod.get_metadata_apps().insert(App(0, "fleetchaos"))
+        le = storage_mod.get_levents()
+        le.init(aid)
+        evs = []
+        for u in range(n_users):
+            for i in rng.choice(n_items, size=6, replace=False):
+                evs.append(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    event_time=t0_evt))
+        evs.append(Event(
+            event="$set", entity_type="constraint",
+            entity_id="unavailableItems",
+            properties={"items": [f"i{n_items - 1}"]},
+            event_time=t0_evt))
+        le.insert_batch(evs, aid)
+
+        user_map = BiMap.string_int({f"u{u}": None
+                                     for u in range(n_users)})
+        item_map = BiMap.string_int({f"i{i}": None
+                                     for i in range(n_items)})
+        model = ECommModel(
+            rank=rank,
+            user_features=rng.standard_normal(
+                (n_users, rank)).astype(np.float32),
+            product_features=rng.standard_normal(
+                (n_items, rank)).astype(np.float32),
+            user_map=user_map, item_map=item_map,
+            items={ix: Item() for ix in range(n_items)})
+        algo = ECommAlgorithm(ECommAlgorithmParams(
+            app_name="fleetchaos", unseen_only=True))
+        users = [f"u{int(u)}"
+                 for u in rng.integers(0, n_users, size=n_queries)]
+
+        def lane():
+            samples, errors, degraded = [], 0, 0
+            reasons: set = set()
+            for u in users:
+                t0 = time.perf_counter()
+                try:
+                    with resilience.degraded_scope() as marks:
+                        algo.predict(model, Query(user=u, num=10))
+                except Exception:
+                    errors += 1
+                    marks = []
+                degraded += bool(marks)
+                reasons.update(marks)
+                samples.append((time.perf_counter() - t0) * 1e3)
+            a = np.asarray(samples)
+            return {"p50_ms": round(float(np.percentile(a, 50)), 3),
+                    "p99_ms": round(float(np.percentile(a, 99)), 3),
+                    "error_rate": round(errors / len(users), 4),
+                    "degraded_rate": round(degraded / len(users), 4),
+                    "degraded_reasons": sorted(reasons)}
+
+        lane()  # warm code paths
+        healthy = lane()
+
+        fleet_dao = unwrap(le)
+        victim = fleet_dao._shard_for_entity("constraint",
+                                             "unavailableItems")
+        # stop() severs established keep-alive connections, so the
+        # router's pooled wires die with the host like a real crash
+        servers[victim].stop()
+
+        down = lane()
+        topo = fleet_dao.topology()
+        gate = bool(down["error_rate"] == 0.0
+                    and down["degraded_rate"] == 1.0
+                    and "shard_down" in down["degraded_reasons"])
+        return {
+            "shards": shards,
+            "queries": n_queries,
+            "killed_shard": victim,
+            "healthy": healthy,
+            "one_shard_down": down,
+            "healthy_shards_after_kill": topo["healthyShards"],
+            "breaker_states": [s["breakerState"]
+                               for s in topo["shards"]],
+            "gate_100pct_degraded_not_failed": gate,
+            "note": ("the killed shard owns constraint/"
+                     "unavailableItems, so EVERY query's constraint "
+                     "read crosses it: degraded_rate must be exactly "
+                     "1.0 with error_rate 0.0 — partial answers, "
+                     "marked, never 5xx"),
+        }
+    finally:
+        for lg, lvl in zip(quiet, prior_levels):
+            lg.setLevel(lvl)
+        faults.clear()
+        resilience.reset_breakers()
+        resilience.set_enabled(prior_enabled)
+        storage_mod.reset()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
 def foldin_freshness_bench(n_users: int = 64, n_items: int = 48,
                            rank: int = 8, n_probes: int = 8,
                            interval: Optional[float] = None,
@@ -2819,6 +3227,27 @@ def main(smoke: bool = False) -> None:
         **({"n_users": 96, "n_items": 64, "levels": (50.0, 100.0),
             "duration_sec": 1.0, "clients": 4} if smoke else {}))
 
+    # the PR-18 query-server fleet lane: the same closed-loop sweep
+    # through the keep-alive balancer's user-sticky routing, plus a
+    # rolling warm /reload fired UNDER load (zero-failure gate)
+    serving_load_fleet = serving_load_bench(
+        fleet=3,
+        **({"n_users": 96, "n_items": 64, "levels": (50.0, 100.0),
+            "duration_sec": 1.0, "clients": 4} if smoke else {}))
+
+    # PR-18 sharded host plane, ingest side: 1 vs 4 event-server
+    # shards, each a subprocess, fed by subprocess FleetLEvents
+    # routers (>=3x scaling gate)
+    fleet_ingest = fleet_ingest_bench(
+        **({"n_events": 4000, "workers": 4} if smoke else {}))
+
+    # PR-18 dead-shard chaos: EVENTDATA through the registered fleet
+    # source, the constraint-owning shard killed — 100% of queries
+    # must answer degraded (shard_down), 0% fail
+    fleet_chaos = fleet_chaos_serving_bench(
+        **({"n_users": 48, "n_items": 32, "n_queries": 120}
+           if smoke else {}))
+
     # crash-safe training: checkpoint-on vs off wall clock (<3% gate),
     # chunked==unchunked and resumed==uninterrupted equality stamps.
     # Chunks must dwarf the per-dispatch fixed cost (~40ms/program on
@@ -2916,6 +3345,9 @@ def main(smoke: bool = False) -> None:
         "serving": serving,
         "serving_load": serving_load,
         "serving_load_sharded": serving_load_sharded,
+        "serving_load_fleet": serving_load_fleet,
+        "fleet_ingest": fleet_ingest,
+        "fleet_chaos": fleet_chaos,
         "seqrec_train": seqrec_train,
         "serving_load_sequentialrec": serving_load_seqrec,
         "seqrec_quality": seqrec_quality,
@@ -2998,6 +3430,20 @@ def main(smoke: bool = False) -> None:
         "serving_sharded_shards": serving_load_sharded["serve_shards"],
         "serving_sharded_zero_compiles":
             serving_load_sharded["zero_compile_steady_state"],
+        "serving_fleet_p50_ms": serving_load_fleet["p50_ms"],
+        "serving_fleet_replicas": serving_load_fleet["fleet_replicas"],
+        "serving_fleet_warm_reload_gate":
+            serving_load_fleet["fleet"]
+            ["gate_warm_reload_zero_errors"],
+        "fleet_ingest_speedup": fleet_ingest["speedup"],
+        "fleet_ingest_scaling_gate_3x":
+            fleet_ingest["scaling_gate_3x"],
+        "fleet_chaos_degraded_rate":
+            fleet_chaos["one_shard_down"]["degraded_rate"],
+        "fleet_chaos_error_rate":
+            fleet_chaos["one_shard_down"]["error_rate"],
+        "fleet_chaos_gate":
+            fleet_chaos["gate_100pct_degraded_not_failed"],
         "seqrec_train_tokens_per_sec":
             seqrec_train["tokens_per_sec"],
         "seqrec_fresh_jit_compile_sec":

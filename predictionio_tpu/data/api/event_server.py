@@ -31,6 +31,10 @@ import logging
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from predictionio_tpu.utils.http_instrumentation import (
+    SeveringThreadingHTTPServer,
+)
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.data import storage
@@ -142,8 +146,8 @@ class EventServer:
         else:
             sslc = SSLConfiguration(AuthServerConfig())
         self.scheme = "https" if sslc.enabled else "http"
-        self._httpd = ThreadingHTTPServer((self.config.ip, self.config.port),
-                                          Handler)
+        self._httpd = SeveringThreadingHTTPServer(
+            (self.config.ip, self.config.port), Handler)
         if sslc.enabled:
             sslc.wrap_server(self._httpd)
         self._httpd.daemon_threads = True

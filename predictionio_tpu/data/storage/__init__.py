@@ -71,6 +71,14 @@ BACKENDS: Dict[str, Dict[str, str]] = {
         "LEvents": "predictionio_tpu.data.storage.resthttp:RestLEvents",
         "PEvents": "predictionio_tpu.data.storage.resthttp:RestPEvents",
     },
+    # EVENTDATA-only consistent-hash router over N event-server shards:
+    # writes fan out by entity key, reads scatter-gather and merge;
+    # config keys: URLS (comma-separated shard URLs), SERVICE_KEY,
+    # VIRTUAL_NODES, plus resthttp wire keys applied per shard
+    "fleet": {
+        "LEvents": "predictionio_tpu.fleet.router:FleetLEvents",
+        "PEvents": "predictionio_tpu.fleet.router:FleetPEvents",
+    },
 }
 
 

@@ -3,9 +3,11 @@ circuit breaking, and deterministic fault injection.
 
 The storage registry wraps every event-store ``LEvents`` DAO it hands
 out in :class:`DAOMetricsWrapper`, so all four event backends (memory,
-sqlite, jsonlfs, resthttp) report ``pio_storage_op_seconds{backend,op}``
-and ``pio_storage_op_errors_total{backend,op,error}`` without any code
-in the backends themselves. Slow-path attribution rides the
+sqlite, jsonlfs, resthttp) report
+``pio_storage_op_seconds{backend,op,shard}`` and
+``pio_storage_op_errors_total{backend,op,error,shard}`` without any
+code in the backends themselves (``shard`` is empty for direct DAOs;
+the fleet router stamps it on per-shard legs). Slow-path attribution rides the
 request-scoped tracing contextvar: with debug logging on, every storage
 op logs a record tagged with the ``X-Request-ID`` of the HTTP request
 that caused it.
@@ -95,10 +97,15 @@ class DAOMetricsWrapper(base.LEvents):
     """Time + error-count every event-store op against the registry."""
 
     def __init__(self, wrapped: base.LEvents,
-                 backend: Optional[str] = None):
+                 backend: Optional[str] = None, shard: str = ""):
         self._wrapped = wrapped
         self.metrics_backend = backend or getattr(
             wrapped, "metrics_backend", type(wrapped).__name__)
+        # empty for direct DAOs; the fleet router sets the shard index
+        # on the per-shard clients it wraps so fan-out legs are
+        # attributable in pio_storage_op_seconds{shard=...}
+        self.metrics_shard = shard or getattr(
+            wrapped, "metrics_shard", "")
         # resilience surface: the endpoint names the availability
         # domain (a wire URL for resthttp, the backend name locally)
         self.resilience_endpoint = getattr(
@@ -179,11 +186,14 @@ class DAOMetricsWrapper(base.LEvents):
                 error: Optional[BaseException] = None) -> None:
         took = time.perf_counter() - t0
         backend = self.metrics_backend
+        shard = self.metrics_shard
         if error is not None:
             metrics.STORAGE_OP_ERRORS.inc(
-                backend=backend, op=op, error=type(error).__name__)
+                backend=backend, op=op, error=type(error).__name__,
+                shard=shard)
         else:
-            metrics.STORAGE_OP_LATENCY.observe(took, backend=backend, op=op)
+            metrics.STORAGE_OP_LATENCY.observe(
+                took, backend=backend, op=op, shard=shard)
         if logger.isEnabledFor(logging.DEBUG):
             rid = current_request_id() or "-"
             logger.debug("storage %s.%s %.6fs rid=%s%s", backend, op, took,

@@ -35,6 +35,7 @@ import json
 import logging
 import os
 import socket
+import threading
 import urllib.parse
 import urllib.request
 from typing import Iterable, List, Optional, Sequence
@@ -93,8 +94,45 @@ def _parse_retry_after(value: Optional[str]) -> Optional[float]:
         return None  # HTTP-date form: not worth a date parse here
 
 
+class _PooledConn:
+    """A checked-out keep-alive connection. ``close()`` returns the
+    socket to the wire's idle pool when the response was fully drained
+    and the server did not ask to close — so every existing
+    ``conn.close()`` call site (call/stream/redirect hops) participates
+    in reuse without changing; anything else really closes."""
+
+    __slots__ = ("_conn", "_resp", "_wire")
+
+    def __init__(self, conn, resp, wire: "_Wire"):
+        self._conn, self._resp, self._wire = conn, resp, wire
+
+    def close(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is None:
+            return
+        resp = self._resp
+        try:
+            reusable = resp is not None and resp.isclosed() \
+                and not resp.will_close
+        except Exception:
+            reusable = False
+        if reusable:
+            self._wire._checkin(conn)
+        else:
+            conn.close()
+
+
 class _Wire:
     """Shared HTTP plumbing for the storage wire, resilience included.
+
+    Connections are reused: after a fully-drained HTTP/1.1 response the
+    socket goes back to a per-wire idle pool (bounded by config
+    ``pool_max`` / ``$PIO_STORAGE_POOL_MAX``, default 8) and the next
+    call skips the TCP/TLS dial — the fleet router multiplies wire
+    calls by the shard count, so fan-out must not pay a fresh connect
+    per shard per op. A stale keep-alive (server closed the idle
+    socket) fails the reused send fast and falls through to ONE fresh
+    dial; it never consumes a retry-policy attempt.
 
     Timeouts are SPLIT: ``connect_timeout`` (config ``connect_timeout``
     / ``$PIO_STORAGE_CONNECT_TIMEOUT``, default 3s — a dead host must
@@ -138,6 +176,12 @@ class _Wire:
             default_deadline=max(30.0, 2.0 * self.read_timeout
                                  + 2.0 * self.connect_timeout))
         self.breaker = resilience.breaker_for(self.url)
+        self._pool: list = []
+        self._pool_lock = threading.Lock()
+        self._pool_max = int(
+            cfg.get("pool_max")
+            or os.environ.get("PIO_STORAGE_POOL_MAX") or 8)
+        self.pool_reuses = 0  # kept-alive sends (observability/tests)
         self._ssl_ctx = None
         if self._scheme == "https":
             import ssl
@@ -197,12 +241,28 @@ class _Wire:
             headers["X-Idempotency-Retry"] = str(attempt)
         return headers
 
-    def _request_once(self, method: str, pathq: str,
-                      body: Optional[bytes], headers: dict):
-        """One HTTP exchange under the split timeouts. Returns
-        ``(conn, resp)`` — the caller reads and closes. Connect-phase
-        failures are SAFE (nothing was sent); post-send failures are
-        AMBIGUOUS."""
+    def _checkout(self):
+        with self._pool_lock:
+            return self._pool.pop() if self._pool else None
+
+    def _checkin(self, conn) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self._pool_max:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        """Drain the idle keep-alive pool (checked-out connections
+        close themselves through ``_PooledConn``)."""
+        with self._pool_lock:
+            idle, self._pool = self._pool, []
+        for conn in idle:
+            conn.close()
+
+    def _dial(self):
+        """TCP/TLS connect under the connect deadline. Dial failures
+        are SAFE — the request provably never left."""
         import http.client
 
         try:
@@ -224,13 +284,51 @@ class _Wire:
             raise StorageUnavailable(
                 f"event server unreachable at {self.url}: {e}",
                 retry_class=resilience.SAFE) from e
+        # the dial is done: from here each blocking socket op runs
+        # under the (longer) read deadline
+        conn.sock.settimeout(self.read_timeout)
+        # small request/response segments must not wait out a delayed
+        # ACK (Nagle costs a flat ~40ms per exchange on keep-alive)
         try:
-            # the dial is done: from here each blocking socket op runs
-            # under the (longer) read deadline
-            conn.sock.settimeout(self.read_timeout)
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # non-TCP transports (unix sockets in tests)
+        return conn
+
+    def _request_once(self, method: str, pathq: str,
+                      body: Optional[bytes], headers: dict):
+        """One HTTP exchange under the split timeouts. Returns
+        ``(conn, resp)`` — the caller reads and closes (the conn is a
+        :class:`_PooledConn`, so a clean close rejoins the keep-alive
+        pool). Dial failures are SAFE (nothing was sent); post-send
+        failures are AMBIGUOUS — except a failed send on a REUSED idle
+        connection, the classic stale keep-alive (the server is allowed
+        to close an idle socket at any time): that conn is discarded
+        and the exchange falls through to one fresh dial."""
+        import http.client
+
+        pooled = self._checkout()
+        if pooled is not None:
+            try:
+                pooled.request(method, pathq, body=body, headers=headers)
+                resp = pooled.getresponse()
+                self.pool_reuses += 1
+                return _PooledConn(pooled, resp, self), resp
+            except (TimeoutError, socket.timeout) as e:
+                # time passed and the server may have executed: this is
+                # a real timeout, not a stale socket — no silent redial
+                pooled.close()
+                raise StorageTimeout(
+                    f"{method} {self.url}: no response within "
+                    f"{self.read_timeout}s") from e
+            except (OSError, http.client.HTTPException):
+                pooled.close()  # stale keep-alive: fall through, redial
+        conn = self._dial()
+        try:
             conn.request(method, pathq, body=body, headers=headers)
             resp = conn.getresponse()
-            return conn, resp
+            return _PooledConn(conn, resp, self), resp
         except (TimeoutError, socket.timeout) as e:
             conn.close()
             raise StorageTimeout(
@@ -478,7 +576,10 @@ class RestLEvents(base.LEvents):
         return bool(p.get("ok"))
 
     def close(self) -> None:
-        pass
+        self._w.close()  # drain the keep-alive pool
+
+    def shutdown(self) -> None:
+        self._w.close()
 
     # -- writes -----------------------------------------------------------
     def insert(self, event: Event, app_id: int,

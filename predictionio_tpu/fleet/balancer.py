@@ -1,0 +1,439 @@
+"""Query-server fleet mode: N replicas behind one thin balancer.
+
+``pio deploy --fleet N`` builds N in-process :class:`QueryServer`
+replicas (each on an ephemeral loopback port) and binds ONE public
+HTTP/1.1 keep-alive balancer in front of them:
+
+- **Routing** — ``POST /queries.json`` is routed by the query's user
+  key over the SAME consistent-hash ring the storage router uses, so a
+  user's queries always land on one replica. With online fold-in on,
+  every replica tails the full fleet event stream, and sticky routing
+  makes the freshness a user observes monotonic: their events fold on
+  the replica that serves them. Queries without a user key round-robin.
+- **Warm hand-off** — ``POST /reload`` rolls replica by replica: drain
+  one from routing, swap it (the replica's own warm ``/reload``),
+  rejoin, move on. The fleet is never cold and never serves two
+  instances to one user mid-roll (their replica is either pre- or
+  post-swap, not both).
+- **Resilience** — a dead replica is skipped for the next replica in
+  the key's ring preference order; the hop is marked on the serving
+  degraded scope (``replica_down``). Forwarded requests carry
+  ``outbound_context_headers()`` so one trace spans balancer → replica
+  → (storage router) → shard.
+
+Everything runs in one process: the balancer and the replicas share
+the metrics registry, so ``GET /metrics`` on the balancer is the whole
+fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from predictionio_tpu.data import storage
+from predictionio_tpu.fleet.ring import HashRing
+from predictionio_tpu.utils import metrics
+from predictionio_tpu.utils.http_instrumentation import (
+    InstrumentedHandlerMixin,
+    SeveringThreadingHTTPServer,
+)
+from predictionio_tpu.utils.tracing import outbound_context_headers
+from predictionio_tpu.workflow.create_server import (
+    QueryServer,
+    ReloadDowngradeError,
+    ServerConfig,
+    undeploy,
+)
+
+logger = logging.getLogger("pio.fleet.balancer")
+
+# query JSON fields tried (in order) for the sticky routing key
+USER_KEY_FIELDS = ("user", "userId", "uid", "entityId")
+
+FORWARD_TIMEOUT_SEC = 75.0
+
+
+def _storage_topology() -> Optional[Dict[str, Any]]:
+    """The event-store fleet topology when EVENTDATA is the ``fleet``
+    source type (None otherwise) — surfaces per-shard breaker states on
+    the balancer's ``/stats.json``."""
+    try:
+        dao = storage.get_levents()
+    except Exception:
+        return None
+    topo = getattr(dao, "topology", None)
+    if not callable(topo):
+        return None
+    try:
+        return topo()
+    except Exception:
+        logger.exception("storage topology probe failed")
+        return None
+
+
+class _Replica:
+    """One QueryServer plus its routing state."""
+
+    def __init__(self, index: int, server: QueryServer):
+        self.index = index
+        self.server = server
+        self.draining = False
+        self.forward_errors = 0
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    def describe(self) -> Dict[str, Any]:
+        host, port = (None, None)
+        if self.server._httpd is not None:
+            host, port = self.address
+        checks = {}
+        try:
+            checks = self.server.health_checks()
+        except Exception:
+            pass
+        dep = self.server._deployment
+        return {"index": self.index,
+                "address": f"{host}:{port}" if host else None,
+                "draining": self.draining,
+                "ready": bool(checks) and all(checks.values()),
+                "checks": checks,
+                "engineInstanceId": dep.instance.id if dep else None,
+                "forwardErrors": self.forward_errors}
+
+
+class QueryFleet:
+    """N query-server replicas behind one keep-alive balancer."""
+
+    def __init__(self, config: ServerConfig, replicas: int,
+                 engine=None, plugin_context=None, ctx=None,
+                 virtual_nodes: int = 64):
+        if replicas < 1:
+            raise ValueError("--fleet needs at least 1 replica")
+        self.config = config
+        self.replicas: List[_Replica] = []
+        for i in range(replicas):
+            rcfg = dataclasses.replace(config, ip="127.0.0.1", port=0)
+            self.replicas.append(_Replica(i, QueryServer(
+                rcfg, engine=engine, plugin_context=plugin_context,
+                ctx=ctx)))
+        self.ring = HashRing(replicas, virtual_nodes=virtual_nodes)
+        self._rr = 0  # round-robin cursor for keyless queries
+        self._rr_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.scheme = "http"
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self, undeploy_stale: bool = True) -> "QueryFleet":
+        started: List[_Replica] = []
+        try:
+            for rep in self.replicas:
+                # replicas bind ephemeral loopback ports — nothing
+                # stale can hold port 0, skip the probe
+                rep.server.start(undeploy_stale=False)
+                started.append(rep)
+        except Exception:
+            for rep in started:
+                try:
+                    rep.server.stop()
+                except Exception:
+                    pass
+            raise
+        if undeploy_stale:
+            undeploy(self.config.ip, self.config.port)
+        fleet = self
+
+        class Handler(_BalancerHandler):
+            query_fleet = fleet
+
+        self._httpd = SeveringThreadingHTTPServer(
+            (self.config.ip, self.config.port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pio-fleet-balancer",
+            daemon=True)
+        self._thread.start()
+        logger.info("Query fleet: %d replicas behind %s://%s:%d",
+                    len(self.replicas), self.scheme, *self.address)
+        return self
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        assert self._httpd is not None, "fleet not started"
+        host, port = self._httpd.server_address[:2]
+        return str(host), int(port)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            httpd, self._httpd = self._httpd, None
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for rep in self.replicas:
+            try:
+                rep.server.stop()
+            except Exception:
+                logger.exception("replica %d stop failed", rep.index)
+
+    def serve_forever(self) -> None:
+        if self._httpd is None:
+            self.start()
+        assert self._thread is not None
+        self._thread.join()
+
+    # -- routing ----------------------------------------------------------
+    def route(self, body: bytes) -> List[_Replica]:
+        """Replicas to try, in order: the user key's ring preference
+        with draining replicas pushed to the back (drained replicas
+        still serve as a LAST resort — a query is never refused because
+        a roll is in flight)."""
+        key = None
+        try:
+            query = json.loads(body.decode("utf-8"))
+            if isinstance(query, dict):
+                for field in USER_KEY_FIELDS:
+                    if query.get(field) is not None:
+                        key = str(query[field])
+                        break
+        except (ValueError, UnicodeDecodeError):
+            pass
+        if key is not None:
+            order = list(self.ring.preference(key))
+        else:
+            with self._rr_lock:
+                self._rr = (self._rr + 1) % len(self.replicas)
+                start = self._rr
+            order = [(start + i) % len(self.replicas)
+                     for i in range(len(self.replicas))]
+        reps = [self.replicas[i] for i in order]
+        return [r for r in reps if not r.draining] + \
+               [r for r in reps if r.draining]
+
+    # -- rolling reload ---------------------------------------------------
+    def reload(self) -> Dict[str, Any]:
+        """Drain → swap → rejoin, one replica at a time. A downgrade
+        refusal (409 on a single server) aborts the roll with the
+        already-swapped replicas listed — the operator sees exactly how
+        far it got; nothing is ever stopped, so the fleet stays warm."""
+        with self._reload_lock:
+            swapped: List[Dict[str, Any]] = []
+            for rep in self.replicas:
+                rep.draining = True
+                try:
+                    info = rep.server.reload()
+                    swapped.append({"replica": rep.index, **info})
+                except ReloadDowngradeError:
+                    raise
+                finally:
+                    rep.draining = False
+            return {"replicas": swapped}
+
+    # -- observability ----------------------------------------------------
+    def topology(self) -> Dict[str, Any]:
+        reps = [rep.describe() for rep in self.replicas]
+        return {"type": "queryFleet",
+                "replicas": reps,
+                "readyReplicas": sum(1 for r in reps if r["ready"]),
+                "virtualNodes": self.ring.virtual_nodes,
+                "storage": _storage_topology()}
+
+    def status(self) -> Dict[str, Any]:
+        return {"status": "alive", "fleet": self.topology()}
+
+    def stats_json(self) -> Dict[str, Any]:
+        return {**self.status(),
+                "metrics": metrics.registry().snapshot()}
+
+    def health_checks(self) -> Dict[str, bool]:
+        """The fleet is ready while ANY replica is — readiness is the
+        balancer's ability to answer, not every replica's."""
+        reps = [rep.describe() for rep in self.replicas]
+        return {"balancer": self._httpd is not None,
+                "replicas": any(r["ready"] for r in reps)}
+
+
+class _BalancerHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
+    query_fleet: QueryFleet
+    protocol_version = "HTTP/1.1"
+    metrics_server_label = "balancer"
+
+    def log_message(self, fmt, *args):
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+    def _drain(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    _ROUTES = ("/", "/healthz", "/metrics", "/stats.json",
+               "/queries.json", "/reload", "/stop")
+
+    def _route_label(self, path: str) -> str:
+        return path if path in self._ROUTES else "<other>"
+
+    def _dispatch(self, method: str) -> None:
+        import urllib.parse
+
+        path = urllib.parse.urlsplit(self.path).path.rstrip("/") or "/"
+        handle = (lambda: self._do_get(path)) if method == "GET" \
+            else (lambda: self._do_post(path))
+        self._dispatch_instrumented(method, path, handle)
+
+    def _do_get(self, path: str) -> None:
+        fleet = self.query_fleet
+        self._drain()
+        if path == "/":
+            self._respond(200, fleet.status())
+        elif path == "/healthz":
+            self._respond_healthz(fleet.health_checks())
+        elif path == "/metrics":
+            self._respond_prometheus()
+        elif path == "/stats.json":
+            self._respond(200, fleet.stats_json())
+        else:
+            self._respond(404, {"message": "Not Found"})
+
+    def _do_post(self, path: str) -> None:
+        fleet = self.query_fleet
+        body = self._drain()
+        try:
+            if path == "/queries.json":
+                self._forward_query(body)
+            elif path == "/reload":
+                try:
+                    info = fleet.reload()
+                except ReloadDowngradeError as e:
+                    self._respond(409, {"message": str(e)})
+                    return
+                self._respond(200, {"message": "Reloading...", **info})
+            elif path == "/stop":
+                self.close_connection = True
+                self._respond_bytes(
+                    200,
+                    json.dumps({"message": "Shutting down."})
+                    .encode("utf-8"),
+                    "application/json; charset=UTF-8",
+                    extra_headers={"Connection": "close"})
+                threading.Thread(target=fleet.stop, daemon=True).start()
+            else:
+                self._respond(404, {"message": "Not Found"})
+        except Exception as e:
+            logger.exception("unhandled error on POST %s", path)
+            try:
+                self._respond(500, {"message": str(e)})
+            except Exception:
+                pass
+
+    # one keep-alive upstream per (handler thread, replica): the
+    # ThreadingHTTPServer gives each client connection its own thread,
+    # so a persistent client gets persistent upstreams end to end
+    _local = threading.local()
+
+    def _upstream(self, rep: _Replica) -> http.client.HTTPConnection:
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        if rep.server._httpd is None:  # stopped replica: next in ring
+            raise ConnectionRefusedError(
+                f"replica {rep.index} is stopped")
+        host, port = rep.address
+        conn = conns.get(rep.index)
+        if conn is None or (conn.host, conn.port) != (host, port):
+            if conn is not None:
+                conn.close()
+            conn = http.client.HTTPConnection(
+                host, port, timeout=FORWARD_TIMEOUT_SEC)
+            conns[rep.index] = conn
+        return conn
+
+    def _discard_upstream(self, rep: _Replica) -> None:
+        conns = getattr(self._local, "conns", None)
+        if conns is not None:
+            conn = conns.pop(rep.index, None)
+            if conn is not None:
+                conn.close()
+
+    def _forward_once(self, rep: _Replica, body: bytes
+                      ) -> Tuple[int, bytes, Dict[str, str]]:
+        headers = {"Content-Type":
+                   self.headers.get("Content-Type")
+                   or "application/json; charset=UTF-8",
+                   "Content-Length": str(len(body)),
+                   **outbound_context_headers()}
+        for attempt in (0, 1):  # one redial on a stale keep-alive conn
+            conn = self._upstream(rep)
+            try:
+                conn.request("POST", "/queries.json", body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                keep = {}
+                retry_after = resp.getheader("Retry-After")
+                if retry_after:
+                    keep["Retry-After"] = retry_after
+                ctype = resp.getheader("Content-Type") \
+                    or "application/json; charset=UTF-8"
+                if resp.will_close:
+                    self._discard_upstream(rep)
+                return resp.status, payload, {"ctype": ctype, **keep}
+            except (OSError, http.client.HTTPException):
+                self._discard_upstream(rep)
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+    def _forward_query(self, body: bytes) -> None:
+        fleet = self.query_fleet
+        last_err: Optional[Exception] = None
+        hopped = False
+        for rep in fleet.route(body):
+            try:
+                status, payload, extra = self._forward_once(rep, body)
+            except (OSError, http.client.HTTPException) as e:
+                rep.forward_errors += 1
+                last_err = e
+                hopped = True
+                logger.warning("fleet: replica %d unreachable (%r), "
+                               "trying next", rep.index, e)
+                continue
+            if hopped:
+                # the answer came off a non-preferred replica: say so,
+                # the same contract storage uses for a dead shard
+                payload = self._mark_degraded_payload(payload)
+            ctype = extra.pop("ctype")
+            self._respond_bytes(status, payload, ctype,
+                                extra_headers=extra or None)
+            return
+        self._respond(503, {"message": "no query replica reachable",
+                            "error": repr(last_err)})
+
+    @staticmethod
+    def _mark_degraded_payload(payload: bytes) -> bytes:
+        try:
+            doc = json.loads(payload.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return payload
+        if not isinstance(doc, dict):
+            return payload
+        doc["degraded"] = True
+        reasons = list(doc.get("degradedReasons") or [])
+        if "replica_down" not in reasons:
+            reasons.append("replica_down")
+        doc["degradedReasons"] = reasons
+        return json.dumps(doc).encode("utf-8")
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")

@@ -25,6 +25,7 @@ from predictionio_tpu.data import storage
 from predictionio_tpu.data.storage.base import AccessKey, App, generate_access_key
 from predictionio_tpu.utils.http_instrumentation import (
     InstrumentedHandlerMixin,
+    SeveringThreadingHTTPServer,
 )
 
 logger = logging.getLogger("pio.adminserver")
@@ -122,7 +123,8 @@ class AdminServer:
         class Handler(_AdminHandler):
             admin_server = server
 
-        self._httpd = ThreadingHTTPServer((self.config.ip, self.config.port),
+        self._httpd = SeveringThreadingHTTPServer(
+            (self.config.ip, self.config.port),
                                           Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(target=self._httpd.serve_forever,

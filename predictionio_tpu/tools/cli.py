@@ -36,11 +36,34 @@ def cmd_status(args) -> int:
         for repo, src in cfg.repositories.items():
             print(f"[INFO]   {repo} -> {src}")
         storage.verify_all_data_objects()
+        _print_fleet_health(storage)
     except StorageError as e:
         print(f"[ERROR] Storage check failed: {e}", file=sys.stderr)
         return 1
     print("[INFO] Your system is all ready to go.")
     return 0
+
+
+def _print_fleet_health(storage) -> None:
+    """When EVENTDATA is the sharded ``fleet`` source, print per-shard
+    health (the same per-URL breaker states the wire feeds)."""
+    try:
+        dao = storage.get_levents()
+    except Exception:
+        return
+    topo = getattr(dao, "topology", None)
+    if not callable(topo):
+        return
+    t = topo()
+    healthy = t.get("healthyShards", 0)
+    shards = t.get("shards", [])
+    print(f"[INFO] Event-store fleet: {healthy}/{len(shards)} shards "
+          f"healthy ({t.get('virtualNodes')} virtual nodes/shard, "
+          f"{t.get('partialReads', 0)} partial reads served)")
+    for s in shards:
+        state = "ok" if s.get("healthy") else "DOWN"
+        print(f"[INFO]   shard {s['index']}: {s['url']} "
+              f"[{state}, breaker {s.get('breakerState')}]")
 
 
 def cmd_app(args) -> int:
@@ -288,6 +311,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "retrain (forces the DeviceTopK backend; "
                           "cadence via PIO_FOLDIN_INTERVAL / "
                           "PIO_FOLDIN_COUNT)")
+    dep.add_argument("--fleet", type=int, default=1, metavar="N",
+                     help="query-server fleet mode: run N replicas "
+                          "behind one keep-alive balancer on --port "
+                          "(user-sticky hash-ring routing, rolling "
+                          "warm /reload — the fleet is never cold; "
+                          "replicas bind ephemeral loopback ports)")
     dep.add_argument("--batch-window", type=float, default=None,
                      metavar="SEC",
                      help="micro-batch budget in seconds (default "

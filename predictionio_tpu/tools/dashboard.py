@@ -29,6 +29,7 @@ from predictionio_tpu.data import storage
 from predictionio_tpu.utils import tracing
 from predictionio_tpu.utils.http_instrumentation import (
     InstrumentedHandlerMixin,
+    SeveringThreadingHTTPServer,
 )
 
 logger = logging.getLogger("pio.dashboard")
@@ -73,7 +74,8 @@ class Dashboard:
         class Handler(_DashboardHandler):
             dashboard = server
 
-        self._httpd = ThreadingHTTPServer((self.config.ip, self.config.port),
+        self._httpd = SeveringThreadingHTTPServer(
+            (self.config.ip, self.config.port),
                                           Handler)
         self._httpd.daemon_threads = True
         if self.ssl is not None and self.ssl.enabled:

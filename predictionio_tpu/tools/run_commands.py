@@ -493,14 +493,24 @@ def cmd_deploy(args) -> int:
         server_config_path=getattr(args, "server_config", None),
         foldin=foldin,
     )
+    fleet_n = int(getattr(args, "fleet", 1) or 1)
     try:
-        server = QueryServer(config).start()
+        if fleet_n > 1:
+            from predictionio_tpu.fleet.balancer import QueryFleet
+
+            server = QueryFleet(config, replicas=fleet_n).start()
+        else:
+            server = QueryServer(config).start()
     except Exception as e:
         print(f"[ERROR] Deploy failed: {e}", file=sys.stderr)
         return 1
     host, port = server.address
-    print(f"[INFO] Engine is deployed and running. Engine API is live at "
-          f"{server.scheme}://{host}:{port}.")
+    if fleet_n > 1:
+        print(f"[INFO] Engine is deployed on a {fleet_n}-replica fleet. "
+              f"Engine API is live at {server.scheme}://{host}:{port}.")
+    else:
+        print(f"[INFO] Engine is deployed and running. Engine API is live "
+              f"at {server.scheme}://{host}:{port}.")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -516,14 +516,18 @@ MICROBATCH_QUEUE_AT_DISPATCH = REGISTRY.histogram(
     ("batcher",), buckets=COUNT_BUCKETS)
 
 # -- storage ---------------------------------------------------------------
+# ``shard`` is empty for direct (single-store) DAOs; the fleet router
+# stamps it with the shard index on the per-shard legs it issues, so one
+# slow or failing shard is visible inside the fan-out.
 STORAGE_OP_LATENCY = REGISTRY.histogram(
     "pio_storage_op_seconds",
-    "Event-store DAO operation latency by backend and op",
-    ("backend", "op"))
+    "Event-store DAO operation latency by backend, op and shard",
+    ("backend", "op", "shard"))
 STORAGE_OP_ERRORS = REGISTRY.counter(
     "pio_storage_op_errors_total",
-    "Event-store DAO operation failures by backend, op and error class",
-    ("backend", "op", "error"))
+    "Event-store DAO operation failures by backend, op, error class "
+    "and shard",
+    ("backend", "op", "error", "shard"))
 
 # -- resilience (retries, breakers, degradation, fault injection) ----------
 STORAGE_RETRIES = REGISTRY.counter(

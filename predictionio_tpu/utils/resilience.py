@@ -532,6 +532,15 @@ def mark_degraded(reason: str) -> None:
         reasons.append(reason)
 
 
+def in_degraded_scope() -> bool:
+    """True when a :func:`degraded_scope` is collecting marks. Storage
+    layers that can serve PARTIAL results (the fleet router with a
+    dead shard) use this to choose between degrade-and-continue on the
+    serving path and fail-loud everywhere else (training reads must
+    never silently lose a shard's data)."""
+    return _degraded.get() is not None
+
+
 def degrade_reason_for(exc: BaseException) -> str:
     """Canonical degradation label for one storage failure."""
     if isinstance(exc, CircuitOpenError):

@@ -52,6 +52,7 @@ from predictionio_tpu.ops.serving import QueryRejectedError
 from predictionio_tpu.utils import metrics, resilience
 from predictionio_tpu.utils.http_instrumentation import (
     InstrumentedHandlerMixin,
+    SeveringThreadingHTTPServer,
 )
 from predictionio_tpu.utils.tracing import (
     LatencyHistogram,
@@ -798,12 +799,19 @@ class QueryServer:
         process-wide registry snapshot (pio_query_seconds,
         pio_microbatch_*, pio_storage_op_* ... — the same state
         GET /metrics renders as Prometheus text)."""
+        from predictionio_tpu.fleet.balancer import _storage_topology
         from predictionio_tpu.ops import serving as _serving
 
-        return {**self.status(),
-                "batchers": _serving.batcher_stats(),
-                "device": _serving.device_report(),
-                "metrics": metrics.registry().snapshot()}
+        out = {**self.status(),
+               "batchers": _serving.batcher_stats(),
+               "device": _serving.device_report(),
+               "metrics": metrics.registry().snapshot()}
+        # when EVENTDATA is the sharded fleet source, surface the shard
+        # topology (per-shard breaker states, partial-read count) here
+        topo = _storage_topology()
+        if topo is not None:
+            out["storageFleet"] = topo
+        return out
 
     def dispatches_json(self, limit: int = 100) -> Dict[str, Any]:
         """GET /dispatches.json: the device-plane flight recorder —
@@ -880,7 +888,7 @@ class QueryServer:
         last_err: Optional[Exception] = None
         for attempt in range(bind_retries):
             try:
-                self._httpd = ThreadingHTTPServer(
+                self._httpd = SeveringThreadingHTTPServer(
                     (self.config.ip, self.config.port), Handler)
                 break
             except OSError as e:  # bind failure, retry (scala :383-393)

@@ -1,0 +1,575 @@
+"""Sharded host plane suite: the consistent-hash event-store router must
+be INDISTINGUISHABLE from one big store, and the query-server fleet must
+stay warm through rolls and replica loss.
+
+Differentials run the same randomized event stream through a 3-shard
+fleet (each shard a live in-process event server over one of the four
+event backends) and a single reference store, then compare find /
+aggregate / find_since exactly. Chaos scenarios kill a shard mid-flight:
+reads inside a serving degraded scope answer partially and say so
+(``shard_down``), reads outside fail loud, the composed fleet cursor
+holds the dead shard's position so recovery delivers — delayed, never
+lost."""
+
+import datetime as dt
+import json
+import http.client
+import random
+import threading
+
+import pytest
+
+from predictionio_tpu.data import storage as storage_mod
+from predictionio_tpu.data.api.event_server import (
+    EventServer,
+    EventServerConfig,
+)
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage.base import StorageError
+from predictionio_tpu.fleet.ring import HashRing, stable_hash
+from predictionio_tpu.fleet.router import CURSOR_KEY, FleetLEvents
+from predictionio_tpu.utils import faults, metrics, resilience
+
+pytestmark = pytest.mark.fleet
+
+UTC = dt.timezone.utc
+APP = 1
+KEY = "fleet-secret"
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.reset_breakers()
+    faults.clear()
+    yield
+    resilience.reset_breakers()
+    faults.clear()
+
+
+def t(i):
+    return dt.datetime(2022, 3, 1, tzinfo=UTC) + dt.timedelta(seconds=int(i))
+
+
+def rate(user, item, at, val=4.0):
+    # ids pre-assigned so the fleet and the reference store ingest
+    # IDENTICAL events (backends mint ids for id-less inserts)
+    return Event(event="rate", entity_type="user", entity_id=user,
+                 target_entity_type="item", target_entity_id=item,
+                 properties={"rating": val}, event_time=t(at),
+                 event_id=new_event_id())
+
+
+def setp(etype, eid, at, **props):
+    return Event(event="$set", entity_type=etype, entity_id=eid,
+                 properties=props, event_time=t(at),
+                 event_id=new_event_id())
+
+
+def random_stream(seed, n=48, n_users=9, n_items=6):
+    rng = random.Random(seed)
+    events = []
+    for i in range(n):
+        roll = rng.random()
+        when = rng.randrange(n * 2)  # out-of-order, colliding times
+        if roll < 0.5:
+            events.append(rate(f"u{rng.randrange(n_users)}",
+                               f"i{rng.randrange(n_items)}", when,
+                               val=float(rng.randint(1, 5))))
+        elif roll < 0.8:
+            events.append(setp(rng.choice(("user", "item")),
+                               f"e{rng.randrange(n_users)}", when,
+                               **{rng.choice("abc"): i}))
+        else:
+            events.append(Event(
+                event="$unset", entity_type="user",
+                entity_id=f"e{rng.randrange(n_users)}",
+                properties={rng.choice("abc"): 0}, event_time=t(when),
+                event_id=new_event_id()))
+    return events
+
+
+def _shard_source(backend, tmp_path, idx, cleanup):
+    if backend == "memory":
+        return {"type": "memory"}
+    if backend == "sqlite":
+        return {"type": "sqlite", "path": str(tmp_path / f"shard{idx}.db")}
+    if backend == "jsonlfs":
+        return {"type": "jsonlfs", "path": str(tmp_path / f"shard{idx}"),
+                "part_max_events": 7}
+    # resthttp shard: the shard's OWN store is another event server —
+    # the router must compose through a double wire hop unchanged
+    inner = EventServer(
+        EventServerConfig(ip="127.0.0.1", port=0, service_key="inner"),
+        reg=storage_mod.StorageRegistry(storage_mod.StorageConfig(
+            sources={"EV": {"type": "memory"},
+                     "META": {"type": "memory"}},
+            repositories={"EVENTDATA": "EV", "METADATA": "META",
+                          "MODELDATA": "META"}))).start()
+    cleanup.append(inner.stop)
+    host, port = inner.address
+    return {"type": "resthttp", "url": f"http://{host}:{port}",
+            "service_key": "inner"}
+
+
+class ShardCluster:
+    """N live in-process event servers + the fleet DAO over them."""
+
+    def __init__(self, backend, tmp_path, n=3):
+        self.backend = backend
+        self.tmp_path = tmp_path
+        self.cleanup = []
+        self.servers = []
+        self.urls = []
+        for i in range(n):
+            self._start_shard(i)
+        self.fleet = FleetLEvents({"urls": ",".join(self.urls),
+                                   "service_key": KEY})
+
+    def _registry(self, idx):
+        return storage_mod.StorageRegistry(storage_mod.StorageConfig(
+            sources={"EV": _shard_source(self.backend, self.tmp_path,
+                                         idx, self.cleanup),
+                     "META": {"type": "memory"}},
+            repositories={"EVENTDATA": "EV", "METADATA": "META",
+                          "MODELDATA": "META"}))
+
+    def _start_shard(self, idx, port=0):
+        srv = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=port, service_key=KEY),
+            reg=self._registry(idx)).start()
+        host, p = srv.address
+        if idx < len(self.servers):
+            self.servers[idx] = srv
+        else:
+            self.servers.append(srv)
+            self.urls.append(f"http://{host}:{p}")
+        return srv
+
+    def kill_shard(self, idx):
+        # stop() severs established keep-alive connections
+        # (SeveringThreadingHTTPServer), so the router's pooled wires
+        # die with the host — exactly like a real crash; the next use
+        # takes the stale-redial path and gets connection-refused
+        self.servers[idx].stop()
+
+    def restart_shard(self, idx):
+        """Rebind the SAME port with a fresh registry over the same
+        backing path — the disk-backed backends come back with their
+        data, like a restarted host."""
+        port = int(self.urls[idx].rsplit(":", 1)[1])
+        return self._start_shard(idx, port=port)
+
+    def close(self):
+        try:
+            self.fleet.close()
+        except Exception:
+            pass
+        for srv in self.servers:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+        for fn in self.cleanup:
+            try:
+                fn()
+            except Exception:
+                pass
+        if self.backend == "sqlite":
+            from predictionio_tpu.data.storage.sqlite import SqliteClient
+            SqliteClient.shutdown_all()
+
+
+@pytest.fixture(params=["memory", "sqlite", "jsonlfs", "resthttp"])
+def cluster(request, tmp_path):
+    c = ShardCluster(request.param, tmp_path)
+    yield c
+    c.close()
+
+
+@pytest.fixture
+def mem_cluster(tmp_path):
+    c = ShardCluster("memory", tmp_path)
+    yield c
+    c.close()
+
+
+@pytest.fixture
+def reference():
+    from predictionio_tpu.data.storage.memory import MemLEvents
+    ref = MemLEvents({})
+    ref.init(APP)
+    return ref
+
+
+def drain(le, cursor=None, limit=None, rounds=50):
+    """find_since until dry; returns (events, final_cursor)."""
+    out = []
+    for _ in range(rounds):
+        got, cursor = le.find_since(APP, cursor=cursor, limit=limit)
+        if not got:
+            break
+        out.extend(got)
+    return out, cursor
+
+
+class TestHashRing:
+    def test_stable_across_instances(self):
+        a, b = HashRing(4), HashRing(4)
+        keys = [f"user/u{i}" for i in range(200)]
+        assert [a.node_for(k) for k in keys] == \
+               [b.node_for(k) for k in keys]
+        assert stable_hash("user/u1") == stable_hash("user/u1")
+
+    def test_every_node_owns_keyspace(self):
+        ring = HashRing(4)
+        owners = {ring.node_for(f"user/u{i}") for i in range(500)}
+        assert owners == {0, 1, 2, 3}
+
+    def test_preference_is_a_permutation_led_by_owner(self):
+        ring = HashRing(5)
+        for k in ("user/u1", "item/i9", "x"):
+            pref = list(ring.preference(k))
+            assert pref[0] == ring.node_for(k)
+            assert sorted(pref) == [0, 1, 2, 3, 4]
+
+
+class TestFleetDifferential:
+    """Router over N live shards == one big store, per backend."""
+
+    def seed(self, cluster, reference, seed=0):
+        cluster.fleet.init(APP)
+        events = random_stream(seed)
+        ids = cluster.fleet.insert_batch(events, APP)
+        ref_ids = reference.insert_batch(events, APP)
+        assert ids == ref_ids  # batch ids reassemble in input order
+        return events, ids
+
+    def test_find_matches_single_store(self, cluster, reference):
+        self.seed(cluster, reference)
+        fleet = cluster.fleet
+        got = list(fleet.find(APP))
+        want = list(reference.find(APP))
+        assert {e.event_id for e in got} == {e.event_id for e in want}
+        times = [e.event_time for e in got]
+        assert times == sorted(times)  # global merge is time-ordered
+        # filtered scans agree as sets (tie order is backend-private)
+        for kw in ({"event_names": ("rate",)},
+                   {"start_time": t(10), "until_time": t(60)},
+                   {"entity_type": "user"}):
+            assert {e.event_id for e in fleet.find(APP, **kw)} == \
+                   {e.event_id for e in reference.find(APP, **kw)}, kw
+
+    def test_entity_fast_path_exact(self, cluster, reference):
+        events, _ = self.seed(cluster, reference)
+        fleet = cluster.fleet
+        entities = {(e.entity_type, e.entity_id) for e in events}
+        for etype, eid in sorted(entities):
+            for rev in (False, True):
+                got = [e.event_id for e in fleet.find(
+                    APP, entity_type=etype, entity_id=eid, reversed=rev)]
+                want = [e.event_id for e in reference.find(
+                    APP, entity_type=etype, entity_id=eid, reversed=rev)]
+                assert got == want, (etype, eid, rev)
+
+    def test_limit_cuts_global_order(self, cluster, reference):
+        # distinct times: the first-k-by-time answer is unambiguous
+        cluster.fleet.init(APP)
+        events = [rate(f"u{i % 5}", f"i{i % 3}", at=i) for i in range(20)]
+        cluster.fleet.insert_batch(events, APP)
+        reference.insert_batch(events, APP)
+        got = [e.event_id for e in cluster.fleet.find(APP, limit=7)]
+        want = [e.event_id for e in reference.find(APP, limit=7)]
+        assert got == want
+
+    def test_aggregate_matches_single_store(self, cluster, reference):
+        self.seed(cluster, reference, seed=3)
+        for etype in ("user", "item"):
+            got = cluster.fleet.aggregate_properties(APP, etype)
+            want = reference.aggregate_properties(APP, etype)
+            assert got == want, etype
+            # and the replay reference over the merged fleet scan agrees
+            assert cluster.fleet.aggregate_properties_replay(
+                APP, etype) == want, etype
+
+    def test_find_since_drains_exactly_once(self, cluster, reference):
+        events, ids = self.seed(cluster, reference, seed=5)
+        got, cursor = drain(cluster.fleet, limit=7)
+        assert sorted(e.event_id for e in got) == sorted(ids)
+        assert len(got) == len(ids)  # exactly once, no duplicates
+        # incremental: only the new arrivals, in one fleet cursor
+        fresh = [rate("u-new", "i1", at=500 + i) for i in range(5)]
+        fresh_ids = cluster.fleet.insert_batch(fresh, APP)
+        got2, cursor = drain(cluster.fleet, cursor=cursor)
+        assert sorted(e.event_id for e in got2) == sorted(fresh_ids)
+        assert cluster.fleet.find_since(APP, cursor=cursor)[0] == []
+
+
+class TestFleetCursor:
+    """The composed cursor fold-in tails: anchor, drain, watermark."""
+
+    def test_tail_cursor_skips_history(self, mem_cluster):
+        fleet = mem_cluster.fleet
+        fleet.init(APP)
+        fleet.insert_batch([rate(f"u{i}", "i0", at=i)
+                            for i in range(12)], APP)
+        cur = fleet.tail_cursor(APP)
+        assert set(cur[CURSOR_KEY]) == set(mem_cluster.urls)
+        fresh_ids = fleet.insert_batch(
+            [rate(f"u{i}", "i1", at=100 + i) for i in range(9)], APP)
+        got, cur2 = drain(fleet, cursor=cur, limit=4)
+        assert sorted(e.event_id for e in got) == sorted(fresh_ids)
+        assert fleet.find_since(APP, cursor=cur2)[0] == []
+
+    def test_watermark_composes(self, mem_cluster):
+        fleet = mem_cluster.fleet
+        fleet.init(APP)
+        ids = fleet.insert_batch([rate(f"u{i}", "i0", at=i)
+                                  for i in range(6)], APP)
+        wm = fleet.tail_watermark(APP)
+        assert wm is not None
+        assert set(wm["cursor"][CURSOR_KEY]) == set(mem_cluster.urls)
+        # the composed watermark is the LATEST shard's last event
+        assert wm["lastEventId"] == ids[-1]
+
+    def test_shard_metrics_labeled(self, mem_cluster):
+        fleet = mem_cluster.fleet
+        fleet.init(APP)
+        fleet.insert_batch([rate(f"u{i}", "i0", at=i)
+                            for i in range(12)], APP)
+        list(fleet.find(APP))
+        per_shard = [
+            metrics.STORAGE_OP_LATENCY.child(
+                backend="fleet", op="find",
+                shard=str(i)).summary()["count"]
+            for i in range(len(mem_cluster.urls))]
+        assert all(c > 0 for c in per_shard)
+
+
+@pytest.mark.chaos
+class TestDeadShard:
+    def _entity_on(self, fleet, shard):
+        for i in range(1000):
+            if fleet._shard_for_entity("user", f"u{i}") == shard:
+                return f"u{i}"
+        raise AssertionError("ring left a shard empty")
+
+    def seed(self, cluster, n=30):
+        cluster.fleet.init(APP)
+        return cluster.fleet.insert_batch(
+            [rate(f"u{i % 10}", f"i{i % 4}", at=i) for i in range(n)], APP)
+
+    def test_scatter_read_degrades_inside_scope_only(self, mem_cluster):
+        fleet = mem_cluster.fleet
+        self.seed(mem_cluster)
+        before = {e.event_id for e in fleet.find(APP)}
+        mem_cluster.kill_shard(1)
+        # training/admin path: a lost shard is a loud failure
+        with pytest.raises(StorageError):
+            list(fleet.find(APP))
+        # serving path: partial answer, marked
+        with resilience.degraded_scope() as marks:
+            got = {e.event_id for e in fleet.find(APP)}
+        assert "shard_down" in marks
+        assert got and got < before
+        with resilience.degraded_scope() as marks:
+            agg = fleet.aggregate_properties(APP, "user")
+        assert {"shard_down", "partial_aggregation"} <= set(marks)
+        assert isinstance(agg, dict)
+        assert fleet.topology()["partialReads"] >= 2
+
+    def test_entity_fast_path_degrades_to_empty(self, mem_cluster):
+        fleet = mem_cluster.fleet
+        self.seed(mem_cluster)
+        dead_user = self._entity_on(fleet, 1)
+        live_user = self._entity_on(fleet, 0)
+        fleet.insert(rate(dead_user, "i9", at=900), APP)
+        fleet.insert(rate(live_user, "i9", at=901), APP)
+        mem_cluster.kill_shard(1)
+        with resilience.degraded_scope() as marks:
+            dead_read = list(fleet.find(APP, entity_type="user",
+                                        entity_id=dead_user))
+            live_read = list(fleet.find(APP, entity_type="user",
+                                        entity_id=live_user))
+        assert dead_read == [] and "shard_down" in marks
+        assert any(e.target_entity_id == "i9" for e in live_read)
+
+    def test_writes_fail_loud(self, mem_cluster):
+        fleet = mem_cluster.fleet
+        fleet.init(APP)
+        dead_user = self._entity_on(fleet, 2)
+        live_user = self._entity_on(fleet, 0)
+        mem_cluster.kill_shard(2)
+        assert fleet.insert(rate(live_user, "i1", at=1), APP)
+        with pytest.raises(StorageError):
+            fleet.insert(rate(dead_user, "i1", at=2), APP)
+        with pytest.raises(StorageError):
+            fleet.insert_batch([rate(live_user, "i2", at=3),
+                                rate(dead_user, "i2", at=4)], APP)
+
+    def test_cursor_survives_shard_restart(self, tmp_path):
+        """The fold-in guarantee: a dead shard's events are DELAYED,
+        never LOST — its cursor entry freezes while it's down and the
+        tail resumes from exactly there after restart."""
+        c = ShardCluster("jsonlfs", tmp_path)  # disk-backed: survives
+        try:
+            fleet = c.fleet
+            fleet.init(APP)
+            fleet.insert_batch([rate(f"u{i}", "i0", at=i)
+                                for i in range(12)], APP)
+            _, cursor = drain(fleet)
+            pre_death = fleet.insert_batch(
+                [rate(f"u{i}", "i1", at=50 + i) for i in range(9)], APP)
+            c.kill_shard(1)
+            with resilience.degraded_scope() as marks:
+                got, cursor = drain(fleet, cursor=cursor)
+            assert "shard_down" in marks
+            survivors = {e.event_id for e in got}
+            missing = set(pre_death) - survivors
+            assert missing  # the dead shard really held some of them
+            c.restart_shard(1)
+            resilience.reset_breakers()  # operator analog of cooldown
+            got2, cursor = drain(fleet, cursor=cursor)
+            assert {e.event_id for e in got2} == missing
+        finally:
+            c.close()
+
+    def test_all_shards_down_raises_even_degraded(self, mem_cluster):
+        fleet = mem_cluster.fleet
+        self.seed(mem_cluster)
+        for i in range(len(mem_cluster.urls)):
+            mem_cluster.kill_shard(i)
+        with resilience.degraded_scope():
+            with pytest.raises(StorageError):
+                list(fleet.find(APP))
+            with pytest.raises(StorageError):
+                fleet.find_since(APP)
+
+    def test_transient_faults_absorbed_by_wire(self, mem_cluster):
+        """Injected connect-refusals ride the per-shard wire's retry
+        policy — the fleet answer stays complete and unmarked."""
+        fleet = mem_cluster.fleet
+        ids = self.seed(mem_cluster)
+        faults.install("backend=resthttp,kind=refuse,every=3,seed=7")
+        with resilience.degraded_scope() as marks:
+            got = {e.event_id for e in fleet.find(APP)}
+        assert got == set(ids)
+        assert "shard_down" not in marks
+
+
+class TestQueryFleet:
+    @pytest.fixture
+    def fleet(self, mem_storage):
+        from test_query_server import seed_ratings, train_once
+        from predictionio_tpu.fleet.balancer import QueryFleet
+        from predictionio_tpu.workflow import ServerConfig
+
+        seed_ratings()
+        train_once()
+        qf = QueryFleet(ServerConfig(ip="127.0.0.1", port=0),
+                        replicas=3).start(undeploy_stale=False)
+        yield qf
+        qf.stop()
+
+    def _post(self, addr, path, body, headers=None):
+        host, port = addr
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("POST", path, body=json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, json.loads(data) if data else None
+
+    def _get(self, addr, path):
+        host, port = addr
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        data = json.loads(resp.read())
+        conn.close()
+        return resp.status, data
+
+    def test_routing_is_user_sticky(self, fleet):
+        addr = fleet.address
+        for _ in range(4):
+            status, payload = self._post(addr, "/queries.json",
+                                         {"user": "u1", "num": 2})
+            assert status == 200 and payload["itemScores"]
+        counts = [r.server.status()["requestCount"]
+                  for r in fleet.replicas]
+        # one replica owns u1; the others never saw a query
+        assert sorted(counts) == [0, 0, 4]
+        owner = counts.index(4)
+        assert owner == fleet.ring.node_for("u1")
+
+    def test_health_stats_and_topology(self, fleet):
+        status, health = self._get(fleet.address, "/healthz")
+        assert status == 200 and health["ready"] is True
+        status, stats = self._get(fleet.address, "/stats.json")
+        assert status == 200
+        topo = stats["fleet"]
+        assert topo["type"] == "queryFleet"
+        assert topo["readyReplicas"] == 3
+        assert len(topo["replicas"]) == 3
+
+    def test_rolling_reload_stays_warm(self, fleet):
+        addr = fleet.address
+        stop = threading.Event()
+        failures = []
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    status, payload = self._post(
+                        addr, "/queries.json", {"user": "u3", "num": 2})
+                    if status != 200:
+                        failures.append(status)
+                except Exception as e:  # pragma: no cover - fail below
+                    failures.append(repr(e))
+
+        thread = threading.Thread(target=hammer, daemon=True)
+        thread.start()
+        try:
+            status, info = self._post(addr, "/reload", {})
+        finally:
+            stop.set()
+            thread.join(timeout=10)
+        assert status == 200
+        assert len(info["replicas"]) == 3  # every replica swapped
+        assert not failures  # the fleet was never cold
+
+    def test_replica_down_fails_over(self, fleet):
+        addr = fleet.address
+        owner = fleet.ring.node_for("u5")
+        fleet.replicas[owner].server.stop()
+        status, payload = self._post(addr, "/queries.json",
+                                     {"user": "u5", "num": 2})
+        assert status == 200 and payload["itemScores"]
+        assert payload["degraded"] is True
+        assert "replica_down" in payload["degradedReasons"]
+        # and the fleet still reports ready (one replica is enough)
+        status, health = self._get(addr, "/healthz")
+        assert status == 200 and health["ready"] is True
+
+
+class TestWireConnectionReuse:
+    def test_keep_alive_pool_reuses_connections(self, mem_storage):
+        from predictionio_tpu.data.storage.resthttp import RestLEvents
+
+        server = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0, service_key=KEY),
+            reg=mem_storage).start()
+        host, port = server.address
+        le = RestLEvents({"url": f"http://{host}:{port}",
+                          "service_key": KEY})
+        try:
+            le.init(APP)
+            le.insert_batch([rate(f"u{i}", "i0", at=i)
+                             for i in range(5)], APP)
+            for _ in range(4):
+                assert len(list(le.find(APP))) == 5
+            assert le._w.pool_reuses >= 3
+        finally:
+            le.close()
+            server.stop()

@@ -324,19 +324,21 @@ class TestDAOMetricsWrapper:
         dao = DAOMetricsWrapper(MemLEvents({}), backend="memtest")
         assert isinstance(unwrap(dao), MemLEvents)
         before = metrics.STORAGE_OP_LATENCY.child(
-            backend="memtest", op="insert").summary()["count"]
+            backend="memtest", op="insert", shard="").summary()["count"]
         eid = dao.insert(Event(event="$set", entity_type="u",
                                entity_id="1", properties={"a": 1}), 1)
         assert dao.get(eid, 1) is not None
         # lazy find is timed through iterator exhaustion
         assert len(list(dao.find(app_id=1, limit=-1))) == 1
         after = metrics.STORAGE_OP_LATENCY.child(
-            backend="memtest", op="insert").summary()["count"]
+            backend="memtest", op="insert", shard="").summary()["count"]
         assert after == before + 1
         assert metrics.STORAGE_OP_LATENCY.child(
-            backend="memtest", op="find").summary()["count"] >= 1
+            backend="memtest", op="find",
+            shard="").summary()["count"] >= 1
         assert metrics.STORAGE_OP_LATENCY.child(
-            backend="memtest", op="get").summary()["count"] >= 1
+            backend="memtest", op="get",
+            shard="").summary()["count"] >= 1
 
     def test_error_counter_on_failing_store(self):
         from predictionio_tpu.data.storage.memory import MemLEvents
@@ -353,22 +355,23 @@ class TestDAOMetricsWrapper:
 
         dao = DAOMetricsWrapper(Exploding({}), backend="failtest")
         base_ins = metrics.STORAGE_OP_ERRORS.value(
-            backend="failtest", op="insert", error="OSError")
+            backend="failtest", op="insert", error="OSError", shard="")
         base_find = metrics.STORAGE_OP_ERRORS.value(
-            backend="failtest", op="find", error="RuntimeError")
+            backend="failtest", op="find", error="RuntimeError", shard="")
         with pytest.raises(IOError):
             dao.insert(object(), 1)
         with pytest.raises(RuntimeError):
             dao.find(app_id=1)
         assert metrics.STORAGE_OP_ERRORS.value(
             backend="failtest", op="insert",
-            error="OSError") == base_ins + 1
+            error="OSError", shard="") == base_ins + 1
         assert metrics.STORAGE_OP_ERRORS.value(
             backend="failtest", op="find",
-            error="RuntimeError") == base_find + 1
+            error="RuntimeError", shard="") == base_find + 1
         # failures do not pollute the latency histogram
         assert metrics.STORAGE_OP_LATENCY.child(
-            backend="failtest", op="insert").summary()["count"] == 0
+            backend="failtest", op="insert",
+            shard="").summary()["count"] == 0
 
     def test_registry_wraps_all_levents(self, mem_storage):
         from predictionio_tpu.data.storage.observed import DAOMetricsWrapper

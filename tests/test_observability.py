@@ -91,9 +91,11 @@ class TestEventServerMetrics:
         assert samples[("pio_ingest_events_total",
                         (("app_id", str(APP_ID)), ("event", "rate"),
                          ("status", "201")))] >= 3
-        # storage-op latency for the backing store rode along
+        # storage-op latency for the backing store rode along (shard is
+        # empty for direct, non-fleet DAOs)
         assert samples[("pio_storage_op_seconds_count",
-                        (("backend", "memory"), ("op", "insert")))] >= 3
+                        (("backend", "memory"), ("op", "insert"),
+                         ("shard", "")))] >= 3
 
     def test_counter_monotonic_and_buckets_cumulative(self, event_server):
         addr = event_server.address
