@@ -302,16 +302,24 @@ class _Wire:
         ``(conn, resp)`` — the caller reads and closes (the conn is a
         :class:`_PooledConn`, so a clean close rejoins the keep-alive
         pool). Dial failures are SAFE (nothing was sent); post-send
-        failures are AMBIGUOUS — except a failed send on a REUSED idle
-        connection, the classic stale keep-alive (the server is allowed
-        to close an idle socket at any time): that conn is discarded
-        and the exchange falls through to one fresh dial."""
+        failures are AMBIGUOUS — except a failure in the SEND phase on
+        a REUSED idle connection, the classic stale keep-alive (the
+        server is allowed to close an idle socket at any time): that
+        conn is discarded and the exchange falls through to one fresh
+        dial. Once the send completed on a reused socket, a dropped
+        response is AMBIGUOUS exactly like the fresh-dial path — the
+        server may have executed, and a silent redial would re-send
+        behind the back of the ``idempotent=False`` retry protection
+        (an unkeyed event batch appended twice, a committed delete
+        replayed)."""
         import http.client
 
         pooled = self._checkout()
         if pooled is not None:
+            sent = False
             try:
                 pooled.request(method, pathq, body=body, headers=headers)
+                sent = True
                 resp = pooled.getresponse()
                 self.pool_reuses += 1
                 return _PooledConn(pooled, resp, self), resp
@@ -322,8 +330,14 @@ class _Wire:
                 raise StorageTimeout(
                     f"{method} {self.url}: no response within "
                     f"{self.read_timeout}s") from e
-            except (OSError, http.client.HTTPException):
-                pooled.close()  # stale keep-alive: fall through, redial
+            except (OSError, http.client.HTTPException) as e:
+                pooled.close()
+                if sent:
+                    raise StorageUnavailable(
+                        f"event server dropped the connection at "
+                        f"{self.url}: {e}",
+                        retry_class=resilience.AMBIGUOUS) from e
+                # stale keep-alive at send: fall through, redial
         conn = self._dial()
         try:
             conn.request(method, pathq, body=body, headers=headers)

@@ -141,27 +141,37 @@ class QueryFleet:
                 # stale can hold port 0, skip the probe
                 rep.server.start(undeploy_stale=False)
                 started.append(rep)
+            if undeploy_stale:
+                undeploy(self.config.ip, self.config.port)
+            fleet = self
+
+            class Handler(_BalancerHandler):
+                query_fleet = fleet
+
+            self._httpd = SeveringThreadingHTTPServer(
+                (self.config.ip, self.config.port), Handler)
+            self._httpd.daemon_threads = True
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="pio-fleet-balancer", daemon=True)
+            self._thread.start()
         except Exception:
+            # a failure ANYWHERE past the first replica start (another
+            # replica, the stale-port probe, the balancer bind — e.g.
+            # EADDRINUSE) must not leak running replicas
+            if self._httpd is not None:
+                try:
+                    self._httpd.server_close()
+                except Exception:
+                    pass
+                self._httpd = None
+            self._thread = None
             for rep in started:
                 try:
                     rep.server.stop()
                 except Exception:
                     pass
             raise
-        if undeploy_stale:
-            undeploy(self.config.ip, self.config.port)
-        fleet = self
-
-        class Handler(_BalancerHandler):
-            query_fleet = fleet
-
-        self._httpd = SeveringThreadingHTTPServer(
-            (self.config.ip, self.config.port), Handler)
-        self._httpd.daemon_threads = True
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="pio-fleet-balancer",
-            daemon=True)
-        self._thread.start()
         logger.info("Query fleet: %d replicas behind %s://%s:%d",
                     len(self.replicas), self.scheme, *self.address)
         return self
@@ -223,9 +233,10 @@ class QueryFleet:
     # -- rolling reload ---------------------------------------------------
     def reload(self) -> Dict[str, Any]:
         """Drain → swap → rejoin, one replica at a time. A downgrade
-        refusal (409 on a single server) aborts the roll with the
-        already-swapped replicas listed — the operator sees exactly how
-        far it got; nothing is ever stopped, so the fleet stays warm."""
+        refusal aborts the roll with the already-swapped replicas
+        attached to the error (rendered in the 409 body) — the operator
+        sees exactly how far it got; nothing is ever stopped, so the
+        fleet stays warm."""
         with self._reload_lock:
             swapped: List[Dict[str, Any]] = []
             for rep in self.replicas:
@@ -233,7 +244,8 @@ class QueryFleet:
                 try:
                     info = rep.server.reload()
                     swapped.append({"replica": rep.index, **info})
-                except ReloadDowngradeError:
+                except ReloadDowngradeError as e:
+                    e.swapped = list(swapped)
                     raise
                 finally:
                     rep.draining = False
@@ -313,7 +325,10 @@ class _BalancerHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
                 try:
                     info = fleet.reload()
                 except ReloadDowngradeError as e:
-                    self._respond(409, {"message": str(e)})
+                    self._respond(
+                        409,
+                        {"message": str(e),
+                         "replicas": getattr(e, "swapped", [])})
                     return
                 self._respond(200, {"message": "Reloading...", **info})
             elif path == "/stop":

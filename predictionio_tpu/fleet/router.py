@@ -68,6 +68,30 @@ def entity_key(entity_type: str, entity_id: str) -> str:
     return f"{entity_type}/{entity_id}"
 
 
+def _as_utc(t: Any) -> Optional[_dt.datetime]:
+    """Wire timestamp → aware UTC datetime, or None if unparseable."""
+    if isinstance(t, _dt.datetime):
+        d = t
+    else:
+        try:
+            d = _dt.datetime.fromisoformat(str(t).replace("Z", "+00:00"))
+        except ValueError:
+            return None
+    if d.tzinfo is None:
+        d = d.replace(tzinfo=_dt.timezone.utc)
+    return d
+
+
+def _time_newer(a: Any, b: Any) -> bool:
+    """Is timestamp ``a`` strictly after ``b``? Shards may render the
+    same instant with different UTC offsets or precision, so compare as
+    datetimes; string compare is only the last-resort fallback."""
+    da, db = _as_utc(a), _as_utc(b)
+    if da is not None and db is not None:
+        return da > db
+    return str(a) > str(b)
+
+
 def parse_urls(cfg: Dict[str, Any]) -> List[str]:
     raw = cfg.get("urls") or cfg.get("url") or ""
     urls = [u.rstrip("/") for u in re.split(r"[,\s]+", raw) if u]
@@ -391,6 +415,15 @@ class FleetLEvents(base.LEvents):
                    cursor: Optional[Dict] = None,
                    limit: Optional[int] = None
                    ) -> Tuple[List[Event], Dict]:
+        """Fleet tail read. ``limit`` is split as ceil(limit/n) PER
+        SHARD, so one call may return up to n*ceil(limit/n) events — a
+        deliberate loosening of the base contract's "limit bounds one
+        call": per-shard cursors are opaque and already advanced past
+        every delivered event, so truncating fleet-side would DROP the
+        tail the composed cursor has passed (lost events, which the
+        cursor contract forbids). Consumers treat ``limit`` as a
+        per-cycle batch-size hint, never an exact cap — the PR-8
+        fold-in consumer does."""
         n = len(self._set)
         prior: Dict[str, Any] = {}
         if cursor:
@@ -447,7 +480,8 @@ class FleetLEvents(base.LEvents):
         for i, wm in enumerate(results):
             cursors[self.urls[i]] = wm.get("cursor")
             t = wm.get("lastEventTime")
-            if t is not None and (last_time is None or str(t) > str(last_time)):
+            if t is not None and (last_time is None
+                                  or _time_newer(t, last_time)):
                 last_time = t
                 last_id = wm.get("lastEventId")
         return {"cursor": {CURSOR_KEY: cursors},

@@ -100,7 +100,15 @@ class ReloadDowngradeError(RuntimeError):
     """``POST /reload`` refused: the latest completed instance is OLDER
     than the one deployed. With online fold-in live, an accidental
     downgrade throws away every folded user — the operator must
-    undeploy/redeploy explicitly to roll back (rendered as HTTP 409)."""
+    undeploy/redeploy explicitly to roll back (rendered as HTTP 409).
+
+    ``swapped`` — replicas a fleet roll had already swapped before the
+    refusal aborted it (empty for a single server): the 409 body lists
+    them so the operator sees exactly how far the roll got."""
+
+    def __init__(self, *args: Any, swapped: Optional[List[Dict[str, Any]]] = None):
+        super().__init__(*args)
+        self.swapped: List[Dict[str, Any]] = list(swapped or [])
 
 
 def engine_instance_to_engine_params(

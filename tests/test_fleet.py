@@ -539,6 +539,54 @@ class TestQueryFleet:
         assert len(info["replicas"]) == 3  # every replica swapped
         assert not failures  # the fleet was never cold
 
+    def test_balancer_bind_failure_leaves_no_replicas_running(
+            self, mem_storage):
+        import socket as socket_mod
+
+        from test_query_server import seed_ratings, train_once
+        from predictionio_tpu.fleet.balancer import QueryFleet
+        from predictionio_tpu.workflow import ServerConfig
+
+        seed_ratings()
+        train_once()
+        blocker = socket_mod.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        qf = QueryFleet(ServerConfig(ip="127.0.0.1", port=port),
+                        replicas=2)
+        try:
+            with pytest.raises(OSError):
+                qf.start(undeploy_stale=False)
+            assert all(rep.server._httpd is None for rep in qf.replicas), \
+                "an EADDRINUSE balancer bind must not leak replicas"
+        finally:
+            blocker.close()
+
+    def test_downgrade_abort_reports_roll_progress(self, fleet):
+        """A mid-roll downgrade refusal must tell the operator how far
+        the roll got: the 409 body lists the already-swapped replicas."""
+        from predictionio_tpu.workflow import ReloadDowngradeError
+
+        rep1 = fleet.replicas[1]
+        orig = rep1.server.reload
+
+        def refuse():
+            raise ReloadDowngradeError("refusing to reload: downgrade")
+
+        rep1.server.reload = refuse
+        try:
+            status, payload = self._post(fleet.address, "/reload", {})
+        finally:
+            rep1.server.reload = orig
+        assert status == 409
+        assert "refusing" in payload["message"]
+        assert [r["replica"] for r in payload["replicas"]] == [0]
+        # the fleet stayed warm: nothing was stopped, no replica drains
+        assert all(not rep.draining for rep in fleet.replicas)
+        s, health = self._get(fleet.address, "/healthz")
+        assert s == 200 and health["ready"] is True
+
     def test_replica_down_fails_over(self, fleet):
         addr = fleet.address
         owner = fleet.ring.node_for("u5")
@@ -551,6 +599,27 @@ class TestQueryFleet:
         # and the fleet still reports ready (one replica is enough)
         status, health = self._get(addr, "/healthz")
         assert status == 200 and health["ready"] is True
+
+
+class TestWatermarkCompare:
+    def test_fleet_watermark_compares_instants_not_strings(self):
+        from predictionio_tpu.fleet.router import _time_newer
+
+        # 11:00-02:00 IS 13:00Z — later than 12:00Z, though the string
+        # "11..." sorts before "12..."; shards may render offsets
+        # differently and the fleet watermark must not care
+        assert _time_newer("2022-03-01T11:00:00-02:00",
+                           "2022-03-01T12:00:00Z")
+        # same instant under two offsets: neither is strictly newer
+        assert not _time_newer("2022-03-01T12:00:00+00:00",
+                               "2022-03-01T13:00:00+01:00")
+        assert not _time_newer("2022-03-01T13:00:00+01:00",
+                               "2022-03-01T12:00:00+00:00")
+        # naive timestamps are read as UTC; datetimes pass through
+        assert _time_newer(dt.datetime(2022, 3, 1, 12, 0, 1),
+                           "2022-03-01T12:00:00Z")
+        # unparseable values fall back to string order
+        assert _time_newer("b", "a")
 
 
 class TestWireConnectionReuse:

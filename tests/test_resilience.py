@@ -783,6 +783,63 @@ class TestWireConfig:
         # 4 connect-refused attempts + ms backoffs, nowhere near 60s
         assert time.perf_counter() - t0 < 2.0
 
+    def test_pooled_conn_failure_phase_decides_redial_vs_ambiguous(self):
+        """A reused keep-alive socket is only provably stale until the
+        send completes: a SEND failure redials (the server closed the
+        idle socket — nothing executed), but a failure waiting for the
+        RESPONSE means the server may already have committed. That must
+        surface AMBIGUOUS like the fresh-dial path — a silent re-send
+        would bypass idempotent=False (unkeyed append twice, a
+        committed delete replayed)."""
+        from predictionio_tpu.data.storage.resthttp import (
+            StorageUnavailable,
+        )
+
+        class _FakePooled:
+            def __init__(self, fail_at):
+                self.fail_at = fail_at
+                self.closed = False
+
+            def request(self, *a, **k):
+                if self.fail_at == "send":
+                    raise BrokenPipeError("idle socket closed")
+
+            def getresponse(self):
+                raise ConnectionResetError("reset before response")
+
+            def close(self):
+                self.closed = True
+
+        def wire_with(fail_at):
+            w = _Wire({"url": "http://h:1"})
+            pooled = _FakePooled(fail_at)
+            w._checkout = lambda: pooled
+            dials = []
+
+            def fake_dial():
+                dials.append(1)
+                raise StorageUnavailable(
+                    "refused", retry_class=resilience.SAFE)
+
+            w._dial = fake_dial
+            return w, pooled, dials
+
+        # response-phase failure: AMBIGUOUS raise, NO silent redial
+        w, pooled, dials = wire_with("response")
+        with pytest.raises(StorageUnavailable) as ei:
+            w._request_once("POST", "/x", b"b", {})
+        assert resilience.classify(ei.value) == resilience.AMBIGUOUS
+        assert pooled.closed
+        assert not dials, \
+            "a dropped response on a reused conn must never re-send"
+
+        # send-phase failure: the classic stale keep-alive — redial
+        w, pooled, dials = wire_with("send")
+        with pytest.raises(StorageUnavailable) as ei:
+            w._request_once("POST", "/x", b"b", {})
+        assert pooled.closed and dials
+        assert resilience.classify(ei.value) == resilience.SAFE
+
 
 def _inproc_event_server(reg_cfg: StorageConfig):
     reg = StorageRegistry(reg_cfg)
