@@ -2815,6 +2815,243 @@ def fleet_chaos_serving_bench(n_users: int = 96, n_items: int = 64,
                 pass
 
 
+def fleet_observability_bench(n_users: int = 96, n_items: int = 64,
+                              rank: int = 8, n_queries: int = 200,
+                              shards: int = 2, replicas: int = 3,
+                              scrape_iters: int = 20,
+                              poll_sec: float = 2.5,
+                              pass_sec: float = 6.0,
+                              seed: int = 29) -> dict:
+    """PR-19 fleet observability plane: a real fleet (``replicas``
+    query replicas behind the balancer, ``shards`` live event-server
+    shard processes as federation members) measured on three axes:
+
+    - **scrape cycle**: wall time of ``FleetFederation.observe()`` —
+      parallel member ``/metrics`` scrape + parse + merge + SLO
+      evaluation, the cost of one federation round;
+    - **render**: end-to-end ``GET /metrics`` at the balancer (one
+      fleet-wide exposition with member drill-down), time and size;
+    - **overhead gate**: serving QPS through the balancer with the
+      observer polling every ``poll_sec`` (default 2.5s — 4x the
+      production ``PIO_SLO_POLL_SEC=10`` cadence, a deliberate
+      stress margin) vs not polling at all — duration-based
+      alternating passes spanning several poll intervals, best-of
+      per mode, the acceptance gate is <3% QPS loss (observability
+      must ride along free at its real cadence).
+
+    Also asserts the SLO block is live (three objectives evaluated,
+    nothing firing on a healthy fleet)."""
+    import datetime as _dt
+    import http.client
+    import os
+    import threading
+
+    from predictionio_tpu.controller import ComputeContext, EngineParams
+    from predictionio_tpu.data import storage as storage_mod
+    from predictionio_tpu.data.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import StorageConfig
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.fleet.balancer import QueryFleet
+    from predictionio_tpu.ops.als import ALSParams
+    from predictionio_tpu.templates.recommendation import (
+        DataSourceParams,
+        engine_factory,
+    )
+    from predictionio_tpu.utils import metrics as metrics_mod
+    from predictionio_tpu.workflow import ServerConfig, run_train
+    from predictionio_tpu.workflow.create_workflow import (
+        WorkflowConfig,
+        new_engine_instance,
+    )
+
+    rng = np.random.default_rng(seed)
+    t0_evt = _dt.datetime(2024, 1, 1, tzinfo=_dt.timezone.utc)
+    prior_backend = os.environ.get("PIO_SERVING_BACKEND")
+    prior_poll = os.environ.get("PIO_SLO_POLL_SEC")
+    os.environ["PIO_SERVING_BACKEND"] = "device"
+    # the bench drives observation explicitly; the built-in poller
+    # would pollute the polling-OFF serving lane
+    os.environ["PIO_SLO_POLL_SEC"] = "0"
+    servers: list = []
+    qf = None
+    try:
+        for _ in range(shards):
+            servers.append(EventServer(
+                EventServerConfig(ip="127.0.0.1", port=0,
+                                  service_key="obsbench"),
+                reg=storage_mod.StorageRegistry(StorageConfig(
+                    sources={"EV": {"type": "memory"},
+                             "META": {"type": "memory"}},
+                    repositories={"EVENTDATA": "EV",
+                                  "METADATA": "META",
+                                  "MODELDATA": "META"}))).start())
+        urls = ",".join(f"http://{h}:{p}"
+                        for h, p in (s.address for s in servers))
+        storage_mod.reset(StorageConfig(
+            sources={"FLEET": {"type": "fleet", "urls": urls,
+                               "service_key": "obsbench"},
+                     "META": {"type": "memory"}},
+            repositories={"EVENTDATA": "FLEET", "METADATA": "META",
+                          "MODELDATA": "META"}))
+        aid = storage_mod.get_metadata_apps().insert(App(0, "obsbench"))
+        le = storage_mod.get_levents()
+        le.init(aid)
+        le.insert_batch([
+            Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{int(i)}",
+                  properties={"rating": float(rng.integers(3, 6))},
+                  event_time=t0_evt)
+            for u in range(n_users)
+            for i in rng.choice(n_items, size=6, replace=False)], aid)
+        engine = engine_factory()
+        params = EngineParams(
+            data_source_params=("", DataSourceParams(
+                app_name="obsbench")),
+            algorithm_params_list=[
+                ("als", ALSParams(rank=rank, num_iterations=2,
+                                  seed=seed))])
+        cfg = WorkflowConfig(
+            engine_factory="predictionio_tpu.templates."
+                           "recommendation:engine_factory")
+        iid = run_train(engine, params, new_engine_instance(cfg, params),
+                        ctx=ComputeContext())
+        assert iid is not None
+        qf = QueryFleet(ServerConfig(ip="127.0.0.1", port=0),
+                        replicas=replicas).start(undeploy_stale=False)
+        host, port = qf.address
+
+        # -- scrape-cycle wall time (parse + merge + SLO included) ----
+        qf.federation.observe()  # warm keep-alive pool + code paths
+        scrape_ms = []
+        for _ in range(scrape_iters):
+            t0 = time.perf_counter()
+            sc = qf.federation.observe()
+            scrape_ms.append((time.perf_counter() - t0) * 1e3)
+        members_ok = sum(1 for m in sc.members if m.get("ok"))
+        a = np.asarray(scrape_ms)
+
+        # -- federated exposition render over HTTP --------------------
+        render_ms, body = [], b""
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        for _ in range(scrape_iters):
+            t0 = time.perf_counter()
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read()
+            render_ms.append((time.perf_counter() - t0) * 1e3)
+            assert resp.status == 200
+        conn.close()
+        families = metrics_mod.parse_prometheus(body.decode())
+        r = np.asarray(render_ms)
+
+        # SLO block live and quiet on a healthy fleet
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("GET", "/stats.json")
+        stats = json.loads(conn.getresponse().read())
+        conn.close()
+        alerts = stats["alerts"]
+        slo_quiet = not alerts["firing"]
+
+        # -- <3% serving overhead gate --------------------------------
+        bodies = [json.dumps({"user": f"u{u}", "num": 10}).encode()
+                  for u in range(n_users)]
+
+        def qps_pass() -> float:
+            # duration-based: each pass must span several poll
+            # intervals so the ON passes amortize whole scrape
+            # cycles instead of racing one against a short burst
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            done = 0
+            t0 = time.perf_counter()
+            while True:
+                conn.request(
+                    "POST", "/queries.json",
+                    body=bodies[done % len(bodies)],
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 200
+                done += 1
+                wall = time.perf_counter() - t0
+                if wall >= pass_sec and done >= n_queries:
+                    break
+            conn.close()
+            return done / wall
+
+        stop = threading.Event()
+
+        def poller() -> None:
+            while not stop.wait(poll_sec):
+                try:
+                    qf.federation.observe()
+                except Exception:
+                    pass
+
+        qps_pass()  # warm (uncounted)
+        qps_off, qps_on = 0.0, 0.0
+        for _ in range(2):  # alternating passes, best-of per mode
+            qps_off = max(qps_off, qps_pass())
+            stop.clear()
+            th = threading.Thread(target=poller, daemon=True)
+            th.start()
+            try:
+                qps_on = max(qps_on, qps_pass())
+            finally:
+                stop.set()
+                th.join(timeout=5)
+        overhead_pct = max(0.0, (1.0 - qps_on / qps_off) * 100.0)
+
+        return _stamp_device({
+            "shards": shards,
+            "replicas": replicas,
+            "members_scraped_ok": members_ok,
+            "scrape_problems": len(sc.problems),
+            "scrape_cycle_ms_p50": round(float(np.percentile(a, 50)), 3),
+            "scrape_cycle_ms_p99": round(float(np.percentile(a, 99)), 3),
+            "metrics_render_ms_p50": round(float(np.percentile(r, 50)), 3),
+            "metrics_render_bytes": len(body),
+            "metrics_families": len(families),
+            "slo_objectives": len(alerts["objectives"]),
+            "slo_quiet_on_healthy_fleet": slo_quiet,
+            "serving_qps_polling_off": round(qps_off, 1),
+            "serving_qps_polling_on": round(qps_on, 1),
+            "observer_overhead_pct": round(overhead_pct, 2),
+            "gate_overhead_under_3pct": bool(overhead_pct < 3.0),
+            "note": ("scrape cycle = parallel member /metrics scrape + "
+                     "parse + merge + SLO evaluation; overhead gate "
+                     "compares best-of serving QPS through the "
+                     "balancer over %.0fs passes with the observer "
+                     "polling every %.1fs (4x the production "
+                     "PIO_SLO_POLL_SEC=10 cadence) vs not at all"
+                     % (pass_sec, poll_sec)),
+        })
+    finally:
+        if prior_backend is None:
+            os.environ.pop("PIO_SERVING_BACKEND", None)
+        else:
+            os.environ["PIO_SERVING_BACKEND"] = prior_backend
+        if prior_poll is None:
+            os.environ.pop("PIO_SLO_POLL_SEC", None)
+        else:
+            os.environ["PIO_SLO_POLL_SEC"] = prior_poll
+        if qf is not None:
+            try:
+                qf.stop()
+            except Exception:
+                pass
+        storage_mod.reset()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
 def foldin_freshness_bench(n_users: int = 64, n_items: int = 48,
                            rank: int = 8, n_probes: int = 8,
                            interval: Optional[float] = None,
@@ -3248,6 +3485,15 @@ def main(smoke: bool = False) -> None:
         **({"n_users": 48, "n_items": 32, "n_queries": 120}
            if smoke else {}))
 
+    # PR-19 fleet observability plane: federation scrape-cycle wall
+    # time, fleet-wide /metrics render, and the <3% serving-overhead
+    # gate (observer polling on vs off through the balancer)
+    fleet_observability = fleet_observability_bench(
+        **({"n_users": 48, "n_items": 32, "n_queries": 60,
+            "shards": 2, "replicas": 2, "scrape_iters": 5,
+            "pass_sec": 4.0}
+           if smoke else {}))
+
     # crash-safe training: checkpoint-on vs off wall clock (<3% gate),
     # chunked==unchunked and resumed==uninterrupted equality stamps.
     # Chunks must dwarf the per-dispatch fixed cost (~40ms/program on
@@ -3348,6 +3594,7 @@ def main(smoke: bool = False) -> None:
         "serving_load_fleet": serving_load_fleet,
         "fleet_ingest": fleet_ingest,
         "fleet_chaos": fleet_chaos,
+        "fleet_observability": fleet_observability,
         "seqrec_train": seqrec_train,
         "serving_load_sequentialrec": serving_load_seqrec,
         "seqrec_quality": seqrec_quality,
@@ -3444,6 +3691,14 @@ def main(smoke: bool = False) -> None:
             fleet_chaos["one_shard_down"]["error_rate"],
         "fleet_chaos_gate":
             fleet_chaos["gate_100pct_degraded_not_failed"],
+        "fleet_obs_scrape_cycle_ms_p50":
+            fleet_observability["scrape_cycle_ms_p50"],
+        "fleet_obs_overhead_pct":
+            fleet_observability["observer_overhead_pct"],
+        "fleet_obs_overhead_gate_3pct":
+            fleet_observability["gate_overhead_under_3pct"],
+        "fleet_obs_slo_quiet":
+            fleet_observability["slo_quiet_on_healthy_fleet"],
         "seqrec_train_tokens_per_sec":
             seqrec_train["tokens_per_sec"],
         "seqrec_fresh_jit_compile_sec":

@@ -21,9 +21,15 @@ HTTP/1.1 keep-alive balancer in front of them:
   ``outbound_context_headers()`` so one trace spans balancer → replica
   → (storage router) → shard.
 
-Everything runs in one process: the balancer and the replicas share
-the metrics registry, so ``GET /metrics`` on the balancer is the whole
-fleet.
+The balancer and its replicas run in one process and share the metrics
+registry; the event-store shards do NOT. ``GET /metrics`` on the
+balancer is therefore the *federated* fleet exposition (PR 19): the
+local registry plus every remote member's scrape, merged by
+:mod:`predictionio_tpu.obs.federation`, with SLO burn rates
+(:mod:`predictionio_tpu.obs.slo`) evaluated over the merged view.
+``GET /traces/<id>`` assembles the cross-process trace live from every
+member's fragment, and ``GET /traces.json`` unions the fleet's slow
+logs.
 """
 
 from __future__ import annotations
@@ -32,13 +38,19 @@ import dataclasses
 import http.client
 import json
 import logging
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
 
 from predictionio_tpu.data import storage
 from predictionio_tpu.fleet.ring import HashRing
-from predictionio_tpu.utils import metrics
+from predictionio_tpu.obs import assemble as trace_assemble
+from predictionio_tpu.obs.federation import FleetFederation
+from predictionio_tpu.obs.slo import SLOEngine, load_slo_config
+from predictionio_tpu.utils import tracing
 from predictionio_tpu.utils.http_instrumentation import (
     InstrumentedHandlerMixin,
     SeveringThreadingHTTPServer,
@@ -57,6 +69,33 @@ logger = logging.getLogger("pio.fleet.balancer")
 USER_KEY_FIELDS = ("user", "userId", "uid", "entityId")
 
 FORWARD_TIMEOUT_SEC = 75.0
+
+
+def _iso_utc(epoch: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(epoch))
+
+
+def _fetch_member_json(url: str, path: str,
+                       timeout: float = 2.0) -> Optional[Any]:
+    """One short-lived GET against a fleet member; None on any miss
+    (dead member, 404, garbage) — trace assembly and slow-log union
+    degrade member-by-member, they never fail outright."""
+    parts = urlsplit(url)
+    conn = http.client.HTTPConnection(
+        parts.hostname or "127.0.0.1", parts.port or 80, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return None
+        return json.loads(resp.read().decode("utf-8"))
+    except (OSError, ValueError, http.client.HTTPException):
+        return None
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
 
 
 def _storage_topology() -> Optional[Dict[str, Any]]:
@@ -131,6 +170,13 @@ class QueryFleet:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.scheme = "http"
+        # fleet observability plane (PR 19): SLO engine + federation
+        self.slo = SLOEngine(
+            load_slo_config(getattr(config, "slo_config", None)))
+        self.federation = FleetFederation(
+            targets=self._federation_targets, slo=self.slo)
+        self._obs_stop = threading.Event()
+        self._obs_thread: Optional[threading.Thread] = None
 
     # -- lifecycle --------------------------------------------------------
     def start(self, undeploy_stale: bool = True) -> "QueryFleet":
@@ -155,6 +201,7 @@ class QueryFleet:
                 target=self._httpd.serve_forever,
                 name="pio-fleet-balancer", daemon=True)
             self._thread.start()
+            self._start_observer()
         except Exception:
             # a failure ANYWHERE past the first replica start (another
             # replica, the stale-port probe, the balancer bind — e.g.
@@ -182,7 +229,35 @@ class QueryFleet:
         host, port = self._httpd.server_address[:2]
         return str(host), int(port)
 
+    def _start_observer(self) -> None:
+        """Background federation poll: keeps the SLO sample ring fed
+        even when nobody is scraping the balancer. ``PIO_SLO_POLL_SEC``
+        (default 10; <= 0 disables)."""
+        try:
+            interval = float(os.environ.get("PIO_SLO_POLL_SEC", "10")
+                             or 0.0)
+        except ValueError:
+            interval = 10.0
+        if interval <= 0:
+            return
+        self._obs_stop.clear()
+
+        def _loop() -> None:
+            while not self._obs_stop.wait(interval):
+                try:
+                    self.federation.observe()
+                except Exception:
+                    logger.exception("fleet observation failed")
+
+        self._obs_thread = threading.Thread(
+            target=_loop, name="pio-fleet-observer", daemon=True)
+        self._obs_thread.start()
+
     def stop(self) -> None:
+        self._obs_stop.set()
+        if self._obs_thread is not None:
+            self._obs_thread.join(timeout=5)
+            self._obs_thread = None
         if self._httpd is not None:
             httpd, self._httpd = self._httpd, None
             httpd.shutdown()
@@ -190,6 +265,7 @@ class QueryFleet:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self.federation.close()
         for rep in self.replicas:
             try:
                 rep.server.stop()
@@ -263,16 +339,84 @@ class QueryFleet:
     def status(self) -> Dict[str, Any]:
         return {"status": "alive", "fleet": self.topology()}
 
+    def _federation_targets(self) -> List[Tuple[str, str]]:
+        """Remote scrape targets: the event-store shards (separate
+        processes). Replicas are in-process and already covered by the
+        local registry snapshot."""
+        topo = _storage_topology()
+        if not topo or topo.get("type") != "fleet":
+            return []
+        out: List[Tuple[str, str]] = []
+        for shard in topo.get("shards") or ():
+            url = shard.get("url")
+            if url:
+                out.append((f"shard{shard.get('index', len(out))}", url))
+        return out
+
+    def federated_metrics(self) -> str:
+        """The fleet-wide Prometheus exposition (merged + per-member
+        drill-down), served at the balancer's ``GET /metrics``."""
+        return self.federation.observe().prometheus()
+
     def stats_json(self) -> Dict[str, Any]:
-        return {**self.status(),
-                "metrics": metrics.registry().snapshot()}
+        sc = self.federation.observe()
+        fleet_block = {
+            **self.topology(),
+            "members": sc.members,
+            "scrape": {
+                "at": _iso_utc(sc.at),
+                "durationSec": sc.duration_sec,
+                "problems": sc.problems,
+            },
+        }
+        out = {"status": "alive", "fleet": fleet_block,
+               "metrics": sc.merged}
+        out["alerts"] = sc.alerts if sc.alerts is not None \
+            else self.slo.alerts_block()
+        return out
+
+    def assemble_trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """Live cross-process assembly: this process's fragment (which
+        covers balancer + replicas) plus every remote member's
+        ``GET /traces/<id>``, folded into one tree."""
+        fragments: List[Optional[Dict[str, Any]]] = [
+            tracing.trace_buffer().get(trace_id)]
+        for _name, url in self._federation_targets():
+            fragments.append(
+                _fetch_member_json(url, "/traces/" + trace_id))
+        return trace_assemble.assemble(fragments)
+
+    def fleet_traces_json(self, limit: int = 50) -> Dict[str, Any]:
+        """``GET /traces.json`` at the balancer: the local trace index
+        plus the union of every member's slow log, so the worst query
+        anywhere in the fleet is one GET away."""
+        buf = tracing.trace_buffer()
+        slow = [dict(e, member="balancer") for e in buf.slow_log(limit)]
+        seen = {(e.get("traceId"), e.get("time")) for e in slow}
+        for name, url in self._federation_targets():
+            doc = _fetch_member_json(url, f"/traces.json?limit={limit}")
+            for e in (doc or {}).get("slowLog") or ():
+                key = (e.get("traceId"), e.get("time"))
+                if key in seen:
+                    continue  # in-process member: same buffer as ours
+                seen.add(key)
+                slow.append(dict(e, member=name))
+        slow.sort(key=lambda e: e.get("time") or "", reverse=True)
+        return {"enabled": buf.enabled,
+                "sampleRate": buf.sample_rate,
+                "slowThresholdSec": buf.slow_threshold_sec,
+                "traces": buf.index(limit),
+                "slowLog": slow[:limit]}
 
     def health_checks(self) -> Dict[str, bool]:
         """The fleet is ready while ANY replica is — readiness is the
-        balancer's ability to answer, not every replica's."""
+        balancer's ability to answer, not every replica's. A firing
+        SLO alert flips the ``slo_alerts`` readiness detail (liveness
+        untouched — the process answers 503, it does not die)."""
         reps = [rep.describe() for rep in self.replicas]
         return {"balancer": self._httpd is not None,
-                "replicas": any(r["ready"] for r in reps)}
+                "replicas": any(r["ready"] for r in reps),
+                "slo_alerts": not self.slo.firing()}
 
 
 class _BalancerHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
@@ -288,9 +432,11 @@ class _BalancerHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         return self.rfile.read(length) if length else b""
 
     _ROUTES = ("/", "/healthz", "/metrics", "/stats.json",
-               "/queries.json", "/reload", "/stop")
+               "/queries.json", "/reload", "/stop", "/traces.json")
 
     def _route_label(self, path: str) -> str:
+        if path.startswith("/traces/"):
+            return "/traces/<id>"
         return path if path in self._ROUTES else "<other>"
 
     def _dispatch(self, method: str) -> None:
@@ -301,6 +447,12 @@ class _BalancerHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
             else (lambda: self._do_post(path))
         self._dispatch_instrumented(method, path, handle)
 
+    def _query_params(self) -> Dict[str, List[str]]:
+        import urllib.parse
+
+        return urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query)
+
     def _do_get(self, path: str) -> None:
         fleet = self.query_fleet
         self._drain()
@@ -309,9 +461,32 @@ class _BalancerHandler(InstrumentedHandlerMixin, BaseHTTPRequestHandler):
         elif path == "/healthz":
             self._respond_healthz(fleet.health_checks())
         elif path == "/metrics":
-            self._respond_prometheus()
+            # the FEDERATED exposition: merged fleet series + member=
+            # drill-down, not just this process's registry
+            self._respond_bytes(
+                200, fleet.federated_metrics().encode("utf-8"),
+                "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/stats.json":
             self._respond(200, fleet.stats_json())
+        elif path == "/traces.json":
+            query = self._query_params()
+            try:
+                limit = min(int(self._q_first(query, "limit") or 50),
+                            500)
+            except ValueError:
+                limit = 50
+            self._respond(200, fleet.fleet_traces_json(limit))
+        elif path.startswith("/traces/"):
+            query = self._query_params()
+            trace_id = path[len("/traces/"):]
+            rec = fleet.assemble_trace(trace_id)
+            if rec is None:
+                self._respond(
+                    404,
+                    {"message": f"trace {trace_id} not found "
+                                "on any fleet member"})
+            else:
+                self._respond_trace_record(rec, query)
         else:
             self._respond(404, {"message": "Not Found"})
 

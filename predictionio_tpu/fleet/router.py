@@ -155,8 +155,12 @@ class _ShardSet:
                 return
             self._closed = True
         for c in self.clients:
+            # PEvents-shaped clients have no wire of their own to close
+            fn = getattr(c, "close", None)
+            if fn is None:
+                continue
             try:
-                c.close()
+                fn()
             except Exception:
                 logger.exception("fleet shard close failed (non-fatal)")
         self.pool.shutdown(wait=False)

@@ -1,0 +1,26 @@
+"""Fleet observability plane (PR 19).
+
+One pane of glass over a sharded deployment (balancer + N query
+replicas + N event-store shards, and later the multi-host plane):
+
+- :mod:`predictionio_tpu.obs.federation` — scrape every fleet member's
+  ``/metrics`` (+ ``/healthz`` / ``/stats.json``) in parallel and merge
+  the series into ONE fleet-wide exposition (counters summed, gauges
+  per-member, histograms folded bucket-exactly through
+  ``LatencyHistogram.merge``).
+- :mod:`predictionio_tpu.obs.assemble` — merge per-process trace
+  fragments into one cross-process span tree (the PR-4 trace-dir merge
+  rules, shared between the offline dir reader and the balancer's live
+  ``GET /traces/<id>`` fan-out).
+- :mod:`predictionio_tpu.obs.slo` — declarative service-level
+  objectives evaluated as multi-window burn rates (fast/slow windows,
+  Google-SRE style) over the federated metrics; firing alerts flip the
+  balancer's readiness detail.
+
+Submodules are imported directly (``from predictionio_tpu.obs import
+federation``); this package intentionally imports nothing at module
+scope so :mod:`predictionio_tpu.utils.tracing` can lazily reach
+:mod:`.assemble` without an import cycle.
+"""
+
+__all__ = ["assemble", "federation", "slo"]

@@ -22,7 +22,9 @@ def cmd_version(args) -> int:
 
 def cmd_status(args) -> int:
     """Verify storage wiring (Console status -> Storage.verifyAllDataObjects,
-    Storage.scala:335-358)."""
+    Storage.scala:335-358). With ``--fleet URL``, also scrape a running
+    balancer's federated ``/stats.json`` and print member health + SLO
+    alerts."""
     from predictionio_tpu.data import storage
     from predictionio_tpu.data.storage.base import StorageError
 
@@ -40,7 +42,52 @@ def cmd_status(args) -> int:
     except StorageError as e:
         print(f"[ERROR] Storage check failed: {e}", file=sys.stderr)
         return 1
+    fleet_url = getattr(args, "fleet", None)
+    if fleet_url:
+        if _print_balancer_status(fleet_url) != 0:
+            return 1
     print("[INFO] Your system is all ready to go.")
+    return 0
+
+
+def _print_balancer_status(url: str) -> int:
+    """Federated fleet summary off a balancer's ``/stats.json``
+    (``pio status --fleet URL``)."""
+    from predictionio_tpu.tools import top_command
+
+    try:
+        stats = top_command._fetch(url.rstrip("/") + "/stats.json")
+    except Exception as e:
+        print(f"[ERROR] Fleet balancer {url} unreachable: {e}",
+              file=sys.stderr)
+        return 1
+    fleet = stats.get("fleet") or {}
+    members = fleet.get("members") or []
+    scrape = fleet.get("scrape") or {}
+    print(f"[INFO] Query fleet: {fleet.get('readyReplicas', 0)}/"
+          f"{len(fleet.get('replicas') or [])} replicas ready, "
+          f"{len(members)} observability members "
+          f"(scrape {float(scrape.get('durationSec') or 0) * 1e3:.1f}ms, "
+          f"{len(scrape.get('problems') or [])} problems)")
+    for m in members:
+        state = "ok" if m.get("ok") else (m.get("reason") or "down")
+        if m.get("inProcess"):
+            state += ", in-process"
+        print(f"[INFO]   member {m.get('member', '?')}: "
+              f"{m.get('url') or 'local'} [{state}]")
+    alerts = stats.get("alerts") or {}
+    firing = alerts.get("firing") or []
+    if firing:
+        print(f"[WARN] SLO alerts FIRING: {', '.join(firing)}")
+        for name in firing:
+            obj = (alerts.get("objectives") or {}).get(name) or {}
+            burn = obj.get("burn") or {}
+            print(f"[WARN]   {name}: burn fast {burn.get('fast')} / "
+                  f"slow {burn.get('slow')} (threshold "
+                  f"{alerts.get('burnThreshold')}), since "
+                  f"{obj.get('since', '?')}")
+    else:
+        print("[INFO] SLO alerts: none firing")
     return 0
 
 
@@ -159,8 +206,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("version", help="print version").set_defaults(
         func=cmd_version)
-    sub.add_parser("status", help="verify storage configuration").set_defaults(
-        func=cmd_status)
+    st = sub.add_parser("status", help="verify storage configuration")
+    st.add_argument("--fleet", default=None, metavar="URL",
+                    help="also scrape a running fleet balancer's "
+                         "federated /stats.json at URL and print "
+                         "member health + SLO alert state")
+    st.set_defaults(func=cmd_status)
 
     app = sub.add_parser("app", help="manage apps")
     app_sub = app.add_subparsers(dest="app_command")
@@ -317,6 +368,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "(user-sticky hash-ring routing, rolling "
                           "warm /reload — the fleet is never cold; "
                           "replicas bind ephemeral loopback ports)")
+    dep.add_argument("--slo-config", default=None, metavar="JSON|PATH",
+                     help="fleet-mode SLO objectives: inline JSON or a "
+                          "file path layered over the defaults and "
+                          "$PIO_SLO_* env (windows, burn threshold, "
+                          "per-objective budget/thresholdSec/disabled "
+                          "— see README 'Fleet observability')")
     dep.add_argument("--batch-window", type=float, default=None,
                      metavar="SEC",
                      help="micro-batch budget in seconds (default "
@@ -485,6 +542,11 @@ def build_parser() -> argparse.ArgumentParser:
     top.add_argument("--once", action="store_true",
                      help="print one plain snapshot and exit "
                           "(scripts/CI; no ANSI)")
+    top.add_argument("--fleet", action="store_true",
+                     help="point --url at a fleet balancer: renders "
+                          "the federated member table + SLO burn-rate "
+                          "lines (and warns if the target serves no "
+                          "fleet block)")
     top.set_defaults(func=top_command.cmd_top)
 
     tpl = sub.add_parser("template", help="engine template scaffolds")

@@ -492,6 +492,7 @@ def cmd_deploy(args) -> int:
         access_key=args.accesskey,
         server_config_path=getattr(args, "server_config", None),
         foldin=foldin,
+        slo_config=getattr(args, "slo_config", None),
     )
     fleet_n = int(getattr(args, "fleet", 1) or 1)
     try:

@@ -160,10 +160,46 @@ def render(stats: Dict[str, Any], dispatches: Dict[str, Any],
             f"{foldin.get('pendingEvents', 0)} · "
             f"{'STALE' if foldin.get('stale') else 'fresh'} · "
             f"solve {_fmt_us(foldin.get('lastSolveDeviceUs'))}")
+
+    # -- fleet federation (balancer /stats.json, `pio top --fleet`) --------
+    fleet = stats.get("fleet") or {}
+    members = fleet.get("members")
+    if members:
+        scrape = fleet.get("scrape") or {}
+        lines.append(
+            f"fleet    {fleet.get('readyReplicas', 0)}/"
+            f"{len(fleet.get('replicas') or ())} replicas ready · "
+            f"{len(members)} members · scrape "
+            f"{float(scrape.get('durationSec') or 0) * 1e3:.1f}ms · "
+            f"problems {len(scrape.get('problems') or ())}")
+        for m in members:
+            state = "ok" if m.get("ok") else (m.get("reason") or "down")
+            extra = " in-process" if m.get("inProcess") else ""
+            lines.append(
+                f"member   {str(m.get('member', '?')):<10} "
+                f"{str(m.get('url') or 'local'):<28} [{state}{extra}]")
+    alerts = stats.get("alerts")
+    if alerts is not None:
+        firing = alerts.get("firing") or []
+        lines.append(
+            f"slo      firing: "
+            f"{', '.join(firing) if firing else 'none'} · "
+            f"burn threshold {alerts.get('burnThreshold')}")
+        for name, obj in (alerts.get("objectives") or {}).items():
+            burn = obj.get("burn") or {}
+            line = (f"slo      {name:<20} "
+                    f"burn fast {float(burn.get('fast', 0)):.2f} / "
+                    f"slow {float(burn.get('slow', 0)):.2f} · "
+                    f"budget left "
+                    f"{float(obj.get('budgetRemaining', 1.0)):.1%}")
+            if obj.get("firing"):
+                line += f" · FIRING since {obj.get('since', '?')}"
+            lines.append(line)
     return "\n".join(lines)
 
 
-def snapshot(url: str, prev: Optional[Tuple[float, int]] = None
+def snapshot(url: str, prev: Optional[Tuple[float, int]] = None,
+             expect_fleet: bool = False
              ) -> Tuple[str, Tuple[float, int]]:
     """Fetch + render one frame; returns (text, state-for-next-frame)."""
     stats = _fetch(url.rstrip("/") + "/stats.json")
@@ -172,20 +208,25 @@ def snapshot(url: str, prev: Optional[Tuple[float, int]] = None
     except (urllib.error.URLError, OSError, ValueError):
         dispatches = {}
     text = render(stats, dispatches, prev)
+    if expect_fleet and not (stats.get("fleet") or {}).get("members"):
+        text += ("\nfleet    --fleet requested but " + url +
+                 " has no federated fleet block (not a balancer?)")
     return text, (time.monotonic(), _query_count(stats))
 
 
 def cmd_top(args) -> int:
     url = args.url or DEFAULT_URL
+    expect_fleet = bool(getattr(args, "fleet", False))
     try:
         if args.once:
-            text, _ = snapshot(url)
+            text, _ = snapshot(url, expect_fleet=expect_fleet)
             print(text)
             return 0
         prev: Optional[Tuple[float, int]] = None
         while True:
             try:
-                text, prev = snapshot(url, prev)
+                text, prev = snapshot(url, prev,
+                                      expect_fleet=expect_fleet)
             except (urllib.error.URLError, OSError) as e:
                 text = f"pio top · {url} unreachable: {e}"
             # ANSI clear + home, then the frame — a refreshing view

@@ -34,6 +34,7 @@ new series).
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
@@ -141,9 +142,13 @@ class InstrumentedHandlerMixin:
         load balancer to route elsewhere while the process stays up."""
         checks = {k: bool(v) for k, v in checks.items()}
         ready = all(checks.values())
+        # pid lets a fleet scraper tell a remote member from an
+        # in-process one (tests/benches), which shares this process's
+        # registry and must not be double-counted in federation
         self._respond(200 if ready else 503,
                       {"alive": True, "ready": ready, "checks": checks,
-                       "server": self.metrics_server_label})
+                       "server": self.metrics_server_label,
+                       "pid": os.getpid()})
 
     # -- trace endpoints ---------------------------------------------------
     @staticmethod
@@ -179,6 +184,14 @@ class InstrumentedHandlerMixin:
         if rec is None:
             self._respond(404, {"message": f"trace {trace_id} not found"})
             return
+        self._respond_trace_record(rec, query)
+
+    def _respond_trace_record(
+            self, rec: Dict[str, Any],
+            query: Optional[Dict[str, List[str]]] = None) -> None:
+        """Render an already-resolved trace record in the requested
+        format (shared by the per-process lookup above and the
+        balancer's fleet-assembled ``GET /traces/<id>``)."""
         fmt = self._q_first(query, "format") or "tree"
         if fmt in ("perfetto", "chrome"):
             self._respond(200, tracing.trace_to_chrome(rec))

@@ -30,6 +30,7 @@ label values are low-cardinality (routes are patterns, never raw paths).
 
 from __future__ import annotations
 
+import collections
 import math
 import os
 import re
@@ -73,15 +74,20 @@ def _fmt_le(v: float) -> str:
     return repr(float(v))
 
 
+def _pairs_str(pairs: Sequence[Tuple[str, str]]) -> str:
+    if not pairs:
+        return ""
+    inner = ",".join(f'{n}="{_escape_label_value(str(v))}"'
+                     for n, v in pairs)
+    return "{" + inner + "}"
+
+
 def _label_str(names: Sequence[str], values: Sequence[str],
                extra: Optional[Tuple[str, str]] = None) -> str:
     pairs = [(n, v) for n, v in zip(names, values)]
     if extra is not None:
         pairs.append(extra)
-    if not pairs:
-        return ""
-    inner = ",".join(f'{n}="{_escape_label_value(v)}"' for n, v in pairs)
-    return "{" + inner + "}"
+    return _pairs_str(pairs)
 
 
 class _Metric:
@@ -441,6 +447,231 @@ REGISTRY = MetricsRegistry()
 
 def registry() -> MetricsRegistry:
     return REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# Shareable (de)serialization entry points — fleet federation (PR 19)
+# parses member expositions back into snapshot-shaped families and
+# re-renders merged families; both directions live HERE so they can
+# never drift from render_prometheus()/snapshot() above.
+# ---------------------------------------------------------------------------
+
+def _parse_label_block(line: str, start: int) -> Tuple[Dict[str, str], int]:
+    """Parse ``{a="b",c="d"}`` starting at ``line[start] == '{'``;
+    returns (labels, index just past the closing brace). Handles the
+    text-format escapes (\\\\, \\", \\n) inside quoted values."""
+    labels: Dict[str, str] = {}
+    i = start + 1
+    n = len(line)
+    while i < n:
+        while i < n and line[i] in ", ":
+            i += 1
+        if i < n and line[i] == "}":
+            return labels, i + 1
+        eq = line.find("=", i)
+        if eq == -1:
+            raise MetricError(f"unterminated label block: {line!r}")
+        name = line[i:eq].strip()
+        i = eq + 1
+        if i >= n or line[i] != '"':
+            raise MetricError(f"unquoted label value: {line!r}")
+        i += 1
+        buf: List[str] = []
+        while i < n:
+            ch = line[i]
+            if ch == "\\" and i + 1 < n:
+                nxt = line[i + 1]
+                buf.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                i += 2
+                continue
+            if ch == '"':
+                i += 1
+                break
+            buf.append(ch)
+            i += 1
+        else:
+            raise MetricError(f"unterminated label value: {line!r}")
+        labels[name] = "".join(buf)
+    raise MetricError(f"unterminated label block: {line!r}")
+
+
+def _parse_sample_value(text: str) -> float:
+    text = text.strip().split()[0]
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    return float(text)
+
+
+def parse_prometheus(text: str) -> Dict[str, Any]:
+    """Inverse of :meth:`MetricsRegistry.render_prometheus`: parse a
+    text exposition (version 0.0.4) into the same snapshot-shaped dict
+    :meth:`MetricsRegistry.snapshot` produces, so federation can merge
+    remote members with the local snapshot uniformly.
+
+    Histogram ``max``/``last`` are not carried by the text format and
+    parse as 0.0; summaries are omitted (the merged histogram is
+    rebuilt through :class:`LatencyHistogram`, which recomputes them).
+    Unparseable sample lines raise :class:`MetricError` — a skewed or
+    garbage member should surface as a scrape problem, not as silently
+    partial data."""
+    helps: Dict[str, str] = {}
+    kinds: Dict[str, str] = {}
+    scalars: Dict[str, "collections.OrderedDict"] = {}
+    hists: Dict[str, "collections.OrderedDict"] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):].split(None, 1)
+            if rest:
+                helps[rest[0]] = rest[1] if len(rest) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):].split(None, 1)
+            if len(rest) == 2:
+                kinds[rest[0]] = rest[1].strip()
+            continue
+        if line.startswith("#"):
+            continue
+        brace = line.find("{")
+        sp = line.find(" ")
+        if brace != -1 and (sp == -1 or brace < sp):
+            name = line[:brace]
+            labels, after = _parse_label_block(line, brace)
+            value = _parse_sample_value(line[after:])
+        else:
+            if sp == -1:
+                raise MetricError(f"malformed sample line: {line!r}")
+            name = line[:sp]
+            labels = {}
+            value = _parse_sample_value(line[sp:])
+        base = None
+        part = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) \
+                    and kinds.get(name[:-len(suffix)]) == "histogram":
+                base, part = name[:-len(suffix)], suffix
+                break
+        if base is not None:
+            fam = hists.setdefault(base, collections.OrderedDict())
+            rest_labels = {k: v for k, v in labels.items() if k != "le"}
+            key = tuple(sorted(rest_labels.items()))
+            entry = fam.setdefault(key, {"labels": rest_labels,
+                                         "count": 0, "sum": 0.0,
+                                         "max": 0.0, "last": 0.0,
+                                         "buckets": []})
+            if part == "_bucket":
+                if "le" not in labels:
+                    raise MetricError(
+                        f"histogram bucket without le: {line!r}")
+                entry["buckets"].append({"le": labels["le"],
+                                         "cumulative": int(value)})
+            elif part == "_sum":
+                entry["sum"] = float(value)
+            else:
+                entry["count"] = int(value)
+            continue
+        fam = scalars.setdefault(name, collections.OrderedDict())
+        key = tuple(sorted(labels.items()))
+        fam[key] = {"labels": labels, "value": value}
+    out: Dict[str, Any] = {}
+    for name in sorted(set(scalars) | set(hists)):
+        if name in hists:
+            series: List[Dict[str, Any]] = []
+            for entry in hists[name].values():
+                entry["buckets"].sort(
+                    key=lambda b: float(b["le"].replace("+Inf", "inf")))
+                series.append(entry)
+            out[name] = {"type": "histogram",
+                         "help": helps.get(name, ""), "series": series}
+        else:
+            out[name] = {"type": kinds.get(name, "untyped"),
+                         "help": helps.get(name, ""),
+                         "series": list(scalars[name].values())}
+    return out
+
+
+def histogram_from_snapshot(entry: Dict[str, Any]) -> LatencyHistogram:
+    """Rebuild a :class:`LatencyHistogram` from one snapshot-shaped
+    histogram series entry (cumulative ``le`` buckets). Raises
+    :class:`MetricError` on malformed bucket sets (missing +Inf,
+    non-monotonic cumulative counts) — federation reports these as
+    member problems instead of merging garbage."""
+    buckets = list(entry.get("buckets") or ())
+    if not buckets:
+        raise MetricError("histogram series has no buckets")
+    bounds: List[float] = []
+    cums: List[int] = []
+    for b in buckets:
+        le = str(b["le"])
+        bounds.append(math.inf if le == "+Inf" else float(le))
+        cums.append(int(b["cumulative"]))
+    if not math.isinf(bounds[-1]):
+        raise MetricError("histogram series is missing the +Inf bucket")
+    counts: List[int] = []
+    prev = 0
+    for c in cums:
+        if c < prev:
+            raise MetricError(
+                "histogram cumulative buckets must be non-decreasing")
+        counts.append(c - prev)
+        prev = c
+    try:
+        return LatencyHistogram.from_state(
+            tuple(bounds[:-1]), counts, total=cums[-1],
+            sum_sec=float(entry.get("sum", 0.0)),
+            max_sec=float(entry.get("max", 0.0)),
+            last_sec=float(entry.get("last", 0.0)))
+    except ValueError as exc:
+        raise MetricError(str(exc)) from exc
+
+
+def histogram_snapshot_entry(hist: LatencyHistogram,
+                             labels: Dict[str, str]) -> Dict[str, Any]:
+    """One snapshot-shaped histogram series entry for ``hist`` —
+    byte-identical in structure to :meth:`MetricsRegistry.snapshot`'s
+    histogram entries (used for merged fleet series)."""
+    counts, total, sum_, mx, last = hist.snapshot()
+    bounds = hist.bounds
+    buckets = []
+    for i, acc in enumerate(LatencyHistogram.cumulate(counts)):
+        le = bounds[i] if i < len(bounds) else math.inf
+        buckets.append({"le": _fmt_le(le), "cumulative": acc})
+    return {"labels": dict(labels), "count": total, "sum": sum_,
+            "max": mx, "last": last, "buckets": buckets,
+            "summary": hist.summary()}
+
+
+def render_family_lines(name: str, kind: str,
+                        series: Sequence[Dict[str, Any]],
+                        extra: Optional[Tuple[str, str]] = None
+                        ) -> List[str]:
+    """Sample lines (no HELP/TYPE header) for snapshot-shaped series,
+    matching :meth:`MetricsRegistry.render_prometheus` formatting.
+    ``extra`` appends one more label pair to every sample — federation
+    uses it to stamp ``member=`` on drill-down series."""
+    lines: List[str] = []
+    for entry in series:
+        base = list((entry.get("labels") or {}).items())
+        if extra is not None:
+            base = base + [extra]
+        if kind == "histogram":
+            for b in entry.get("buckets") or ():
+                pairs = base + [("le", str(b["le"]))]
+                lines.append(
+                    f"{name}_bucket{_pairs_str(pairs)}"
+                    f" {int(b['cumulative'])}")
+            ls = _pairs_str(base)
+            lines.append(f"{name}_sum{ls} {repr(float(entry.get('sum', 0.0)))}")
+            lines.append(f"{name}_count{ls} {int(entry.get('count', 0))}")
+        else:
+            lines.append(
+                f"{name}{_pairs_str(base)}"
+                f" {_fmt_value(float(entry.get('value', 0.0)))}")
+    return lines
 
 
 def set_enabled(enabled: bool) -> None:

@@ -197,6 +197,32 @@ class LatencyHistogram:
             return (list(self._counts), self._total, self._sum, self._max,
                     self._last)
 
+    @classmethod
+    def from_state(cls, bounds: Sequence[float], counts: Sequence[int],
+                   total: Optional[int] = None, sum_sec: float = 0.0,
+                   max_sec: float = 0.0,
+                   last_sec: float = 0.0) -> "LatencyHistogram":
+        """Rebuild a histogram from an externalized state (a parsed
+        remote ``/metrics`` exposition, a snapshot entry) so fleet
+        federation can fold member series through :meth:`merge` with
+        exactly the local aggregation rules. ``bounds`` are the finite
+        bucket bounds (the implicit +inf bucket is ``counts[-1]``);
+        ``counts`` are per-bucket (NOT cumulative)."""
+        h = cls(bounds=tuple(float(b) for b in bounds))
+        counts = [int(c) for c in counts]
+        if len(counts) != len(h._bounds) + 1:
+            raise ValueError(
+                "histogram state needs %d counts for %d bounds, got %d"
+                % (len(h._bounds) + 1, len(h._bounds), len(counts)))
+        if any(c < 0 for c in counts):
+            raise ValueError("histogram bucket counts must be >= 0")
+        h._counts = counts
+        h._total = int(total) if total is not None else sum(counts)
+        h._sum = float(sum_sec)
+        h._max = float(max_sec)
+        h._last = float(last_sec)
+        return h
+
     def merge(self, other: "LatencyHistogram") -> None:
         """Fold ``other``'s observations into this histogram (registry
         snapshot aggregation). Bounds must match; ``other`` is read under
@@ -743,6 +769,11 @@ def load_traces_from_dir(path: str, trace_id: Optional[str] = None,
                          ) -> List[Dict[str, Any]]:
     """Read trace records back from a ``--trace-dir``, merging fragments
     of the same trace_id across files (i.e. across processes)."""
+    # the fold itself (topmost-fragment-wins naming, max-duration,
+    # OR'd error/slow) is shared with the balancer's live trace
+    # assembly — see predictionio_tpu/obs/assemble.py. Lazy import:
+    # obs is a subpackage consumer of this module.
+    from predictionio_tpu.obs import assemble as _assemble
     merged: "collections.OrderedDict[str, Dict[str, Any]]" = \
         collections.OrderedDict()
     try:
@@ -777,29 +808,7 @@ def load_traces_from_dir(path: str, trace_id: Optional[str] = None,
                         # the fragment holding the TOPMOST span (no
                         # parent) names the merged trace: "pio.train",
                         # not the event server's wire-request root
-                        def topmost(r):
-                            return any(s.get("parentId") is None
-                                       for s in r.get("spans", ()))
-                        if topmost(rec) and not topmost(prior):
-                            rec["spans"] = list(rec.get("spans", ())) \
-                                + list(prior.get("spans", ()))
-                            rec["durationSec"] = max(
-                                prior.get("durationSec", 0.0),
-                                rec.get("durationSec", 0.0))
-                            rec["error"] = prior.get("error", False) \
-                                or rec.get("error", False)
-                            rec["slow"] = prior.get("slow", False) \
-                                or rec.get("slow", False)
-                            merged[tid] = rec
-                            continue
-                        prior["spans"].extend(rec.get("spans", ()))
-                        prior["durationSec"] = max(
-                            prior.get("durationSec", 0.0),
-                            rec.get("durationSec", 0.0))
-                        prior["error"] = prior.get("error") \
-                            or rec.get("error", False)
-                        prior["slow"] = prior.get("slow") \
-                            or rec.get("slow", False)
+                        merged[tid] = _assemble.fold_fragment(prior, rec)
         except OSError:
             continue
     out = list(merged.values())

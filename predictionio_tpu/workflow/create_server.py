@@ -94,6 +94,10 @@ class ServerConfig:
     # live device store — see predictionio_tpu/online/foldin.py.
     # Cadence knobs: PIO_FOLDIN_INTERVAL / PIO_FOLDIN_COUNT.
     foldin: bool = False
+    # SLO overrides for fleet mode (`pio deploy --fleet N
+    # --slo-config ...`): inline JSON or a file path, layered over
+    # defaults + $PIO_SLO_* — see predictionio_tpu/obs/slo.py
+    slo_config: Optional[str] = None
 
 
 class ReloadDowngradeError(RuntimeError):

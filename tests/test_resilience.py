@@ -1437,7 +1437,7 @@ class TestHealthz:
             assert status == 200
             assert body == {"alive": True, "ready": True,
                             "checks": {"storage": True},
-                            "server": "event"}
+                            "server": "event", "pid": os.getpid()}
             br = resilience.breaker_for("memory")
             for _ in range(br.failure_threshold):
                 br.record_failure(TimeoutError())
