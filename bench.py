@@ -925,6 +925,16 @@ def artifact_schema_problems(artifact: dict) -> list:
             problems.append(f"lane {name!r} missing 'device' stamp")
         if isinstance(lane, dict) and "leaderboard" in lane:
             problems.extend(_leaderboard_schema_problems(name, lane))
+        if isinstance(lane, dict) and name == "serving_twostage":
+            # the ISSUE-20 gates are part of the artifact contract:
+            # the two-stage lane must self-report its QPS ratio, the
+            # zero-compile stamp, and the one-dispatch-per-batch proof
+            for key in ("qps_ratio_two_vs_single",
+                        "zero_compile_both_lanes",
+                        "single_dispatch_per_batch"):
+                if key not in lane:
+                    problems.append(
+                        f"lane {name!r} missing gate key {key!r}")
         if isinstance(lane, dict) and name == "train_telemetry":
             # the ISSUE-17 gates are part of the artifact contract: the
             # telemetry lane must self-report its observer-purity and
@@ -1011,6 +1021,7 @@ def device_audit(out_path: str = "DEVICE_AUDIT.json") -> dict:
     run_lane("foldin_freshness", foldin_freshness_bench)
     run_lane("bf16_training", als_precision_bench)
     run_lane("int8_fused_serving", serving_quantized_lane_bench)
+    run_lane("twostage_serving", twostage_serving_bench)
 
     pallas = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q", "-m", "pallas",
@@ -1804,6 +1815,136 @@ def serving_quantized_lane_bench(n_users: int = 256, n_items: int = 128,
                  "and SLO; the >=2x QPS gate and the ~4x catalog "
                  "claim are DEVICE targets — a cpu-stamped artifact "
                  "is a wiring smoke, not a measurement"),
+    })
+
+
+def twostage_serving_bench(n_users: int = 256, n_items: int = 2048,
+                           rank_retrieval: int = 8,
+                           rank_rerank: int = 64,
+                           candidates: int = 128,
+                           duration_sec: float = 2.0,
+                           clients: int = 8, k: int = 10,
+                           seed: int = 29) -> dict:
+    """The ISSUE-20 acceptance lane: fused two-stage serving (cheap
+    full-catalog retrieval at ``rank_retrieval`` + re-rank of N
+    candidates at ``rank_rerank``, ONE device program) vs single-stage
+    serving that scores the WHOLE catalog at ``rank_rerank`` — the
+    seqrec deployment shape it replaces. Same store machinery both
+    lanes (micro-batcher, AOT ladder, telemetry), so the ratio isolates
+    the algorithmic win: full-catalog work scales with
+    ``n_items * rank_rerank``; two-stage with
+    ``n_items * rank_retrieval + N * rank_rerank``.
+
+    Gates (the QPS target is a DEVICE gate; a cpu-stamped artifact is
+    a wiring smoke):
+
+    - ``qps_ratio_two_vs_single`` > 1.0 — two-stage must beat the
+      single-stage scorer it quality-matches (the equal-NDCG@10 half
+      of the gate is ``bench_quality.run_twostage_check``);
+    - zero-steady-state compiles on BOTH lanes (the two-stage
+      ``(uid, N, k)`` programs ride the same AOT bucket ladder);
+    - one device dispatch per two-stage batch (flight-recorder
+      asserted): retrieval, candidate gather, re-rank, seen mask and
+      final top-k never round-trip candidates through host."""
+    import threading as _threading
+
+    from predictionio_tpu.ops.serving import DeviceTopK
+    from predictionio_tpu.ops.twostage import TwoStageTopK
+    from predictionio_tpu.utils import device_telemetry, metrics
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_users, rank_retrieval)).astype(np.float32)
+    Y = rng.normal(size=(n_items, rank_retrieval)).astype(np.float32)
+    U = rng.normal(size=(n_users, rank_rerank)).astype(np.float32)
+    E = rng.normal(size=(n_items, rank_rerank)).astype(np.float32)
+    seen = {u: rng.choice(n_items, size=5, replace=False)
+            for u in range(0, n_users, 3)}
+
+    single = DeviceTopK(U, E, {u: v.copy() for u, v in seen.items()})
+    two = TwoStageTopK(X, Y, U, E,
+                       seen={u: v.copy() for u, v in seen.items()},
+                       candidates=candidates)
+    metrics.install_jit_compile_listener()
+
+    def lane(store, query_fn):
+        store.warmup(max_k=16, batch_sizes=(8,))
+        c0 = metrics.JIT_COMPILES.value()
+        counts = [0] * clients
+        stop = _threading.Event()
+
+        def worker(i):
+            r = np.random.default_rng(seed + 1 + i)
+            while not stop.is_set():
+                query_fn(int(r.integers(0, n_users)))
+                counts[i] += 1
+
+        threads = [_threading.Thread(target=worker, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_sec)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        wall = time.perf_counter() - t0
+        compiles = metrics.JIT_COMPILES.value() - c0
+        return sum(counts) / wall, int(compiles)
+
+    try:
+        single_qps, single_compiles = lane(
+            single, lambda u: single.user_topk(u, k))
+        two_qps, two_compiles = lane(
+            two, lambda u: two.two_topk(u, k))
+
+        # flight-recorder sample: one batched two-stage query is ONE
+        # "two"-lane device dispatch (no per-stage host round trips)
+        rec = device_telemetry.recorder()
+        was = device_telemetry.enabled()
+        device_telemetry.set_enabled(True)
+        try:
+            rec.reset()
+            two.twos_topk(np.arange(min(8, n_users)), k)
+            sample = rec.snapshot(100)
+            single_dispatch = (len(sample) == 1
+                               and sample[0]["lane"] == "two")
+        finally:
+            device_telemetry.set_enabled(was)
+            rec.reset()
+    finally:
+        single.close()
+        two.close()
+
+    ratio = round(two_qps / single_qps, 2) if single_qps else None
+    on_accel = device_platform() != "cpu"
+    work_full = float(n_items * rank_rerank)
+    work_two = float(n_items * rank_retrieval
+                     + candidates * rank_rerank)
+    return _stamp_device({
+        "accelerator": on_accel,
+        "n_users": n_users, "n_items": n_items,
+        "rank_retrieval": rank_retrieval,
+        "rank_rerank": rank_rerank,
+        "candidates": candidates,
+        "single_stage_qps": round(single_qps, 1),
+        "two_stage_qps": round(two_qps, 1),
+        "qps_ratio_two_vs_single": ratio,
+        "target_qps_ratio": 1.0,
+        "gate_beats_single_stage": (None if not on_accel
+                                    or ratio is None
+                                    else ratio > 1.0),
+        "work_ratio_full_vs_twostage": round(work_full / work_two, 2),
+        "zero_compile_single_lane": single_compiles == 0,
+        "zero_compile_two_lane": two_compiles == 0,
+        "zero_compile_both_lanes": (single_compiles == 0
+                                    and two_compiles == 0),
+        "single_dispatch_per_batch": bool(single_dispatch),
+        "quality_lane": "bench_quality.run_twostage_check",
+        "note": ("fused retrieval + re-rank (one device program per "
+                 "(uid, N, k) bucket) vs single-stage full-catalog "
+                 "scoring at the re-rank rank; the >1x QPS gate is a "
+                 "DEVICE target — the equal-NDCG half of the "
+                 "acceptance gate lives in bench_quality"),
     })
 
 
@@ -3455,6 +3596,17 @@ def main(smoke: bool = False) -> None:
         **({"n_users": 96, "n_items": 64, "levels": (50.0, 100.0),
             "duration_sec": 1.0, "clients": 4} if smoke else {}))
 
+    # the ISSUE-20 two-stage lane: fused retrieval + re-rank as ONE
+    # device program vs single-stage full-catalog scoring at the
+    # re-rank rank (QPS gate; the equal-NDCG half is in bench_quality)
+    serving_twostage = twostage_serving_bench(
+        **({"n_users": 96, "n_items": 256, "rank_rerank": 32,
+            "candidates": 32, "duration_sec": 1.0, "clients": 4}
+           if smoke else {}))
+    twostage_quality = bench_quality.run_twostage_check(
+        **({"n_users": 80, "n_items": 50, "num_steps": 150}
+           if smoke else {}))
+
     # the ISSUE-15 sharded serving lane: same closed-loop sweep with
     # the deployed store density-sharded over the mesh (per-shard
     # top-k + on-device merge; zero-compile gate still asserted). The
@@ -3599,6 +3751,8 @@ def main(smoke: bool = False) -> None:
         "serving_load_sequentialrec": serving_load_seqrec,
         "seqrec_quality": seqrec_quality,
         "serving_quantized": serving_quant,
+        "serving_twostage": serving_twostage,
+        "twostage_quality": twostage_quality,
         "instrumentation_overhead": overhead,
         "tracing_overhead": tracing_overhead,
         "device_telemetry_overhead": telemetry_overhead,
@@ -3715,6 +3869,15 @@ def main(smoke: bool = False) -> None:
             serving_quant["catalog_capacity_ratio_vs_fp32"],
         "serving_int8_zero_compiles":
             serving_quant["zero_compile_both_lanes"],
+        "twostage_qps_ratio_vs_single":
+            serving_twostage["qps_ratio_two_vs_single"],
+        "twostage_zero_compiles":
+            serving_twostage["zero_compile_both_lanes"],
+        "twostage_single_dispatch":
+            serving_twostage["single_dispatch_per_batch"],
+        "twostage_ndcg_at_10": twostage_quality["ndcg_two_stage"],
+        "twostage_ndcg_gate":
+            twostage_quality["gate_ndcg_not_worse"],
         "batchpredict_bulk_qps": batchpredict["bulk_queries_per_sec"],
         "batchpredict_speedup_vs_looped":
             batchpredict["speedup_vs_looped"],

@@ -481,6 +481,134 @@ def run_seqrec_check(n_users: int = 200, n_items: int = 100,
     }
 
 
+def run_twostage_check(n_users: int = 200, n_items: int = 100,
+                       min_len: int = 4, max_len: int = 24,
+                       num_steps: int = 400, rank_retrieval: int = 32,
+                       rank_rerank: int = 32, candidates: int = None,
+                       seed: int = 11, k: int = K) -> dict:
+    """Quality gate for fused two-stage serving (ISSUE 20 acceptance):
+    on the seqrec gate's Markov chain stream, the two-stage combination
+    (ALS retrieval -> seqrec re-rank through the REAL
+    :class:`~predictionio_tpu.ops.twostage.TwoStageTopK` device store)
+    must reach NDCG@10 >= max(ALS alone, seqrec alone).
+
+    Why this holds and what it proves: ALS sees only the SET of items
+    per user (the marginal item distribution of the stride walks is
+    near uniform, so ALS retrieval is weak on its own but its top-N
+    still covers the catalog well at N >= |catalog|/2); seqrec learns
+    the transition structure. Re-ranking the retrieval candidates by
+    the sequence model recovers (at full recall, equals) the sequence
+    model's ranking — fusing the two stages into one device program
+    must not cost quality. The default candidate budget is the FULL
+    catalog, where stage 1 has recall 1.0 and the fused program is
+    bit-exact to brute-force re-ranking (tests/test_twostage.py), so
+    the gate is deterministic; ``als_recall_at_half_catalog`` reports
+    how much of that recall a halved budget would keep. The two-stage
+    list itself comes from ``TwoStageTopK.twos_topk`` so the gate
+    exercises the served kernel, not a host reimplementation."""
+    from predictionio_tpu.data.sliding import ndcg_at_k
+    from predictionio_tpu.ops.als import ALSParams, pad_ratings, train_als
+    from predictionio_tpu.ops.seqrec import (
+        SeqRecParams,
+        bucket_sequences,
+        encode_users,
+        train_seqrec,
+    )
+    from predictionio_tpu.ops.twostage import TwoStageTopK
+
+    if candidates is None:
+        candidates = n_items
+
+    rng = np.random.default_rng(seed)
+    strides = (1, 3, 7)
+    seqs, next_item = [], []
+    for _ in range(n_users):
+        start = int(rng.integers(0, n_items))
+        stride = int(strides[rng.integers(0, len(strides))])
+        n = int(rng.integers(min_len, max_len))
+        walk = (start + stride * np.arange(n + 1)) % n_items
+        seqs.append(walk[:-1].astype(np.int64))
+        next_item.append(int(walk[-1]))
+    seen = {u: np.unique(seqs[u]) for u in range(n_users)}
+
+    # --- stage-1 model: implicit ALS on the walks' (user, item) set
+    rows = np.concatenate([np.full(len(s), u, dtype=np.int64)
+                           for u, s in enumerate(seqs)])
+    cols = np.concatenate(seqs)
+    key = rows * n_items + cols
+    uniq = np.unique(key)
+    rows, cols = uniq // n_items, uniq % n_items
+    vals = np.ones(len(rows), dtype=np.float32)
+    als_params = ALSParams(rank=rank_retrieval, num_iterations=ITERATIONS,
+                           lambda_=LAMBDA, alpha=ALPHA,
+                           implicit_prefs=True, seed=3)
+    X_als, Y_als = train_als(pad_ratings(rows, cols, vals, n_users, n_items),
+                             pad_ratings(cols, rows, vals, n_items, n_users),
+                             als_params)
+    X_als, Y_als = np.asarray(X_als), np.asarray(Y_als)
+
+    # --- stage-2 model: seqrec on the same walks
+    seq_params = SeqRecParams(rank=rank_rerank, n_layers=2, n_heads=2,
+                              max_seq_len=max_len, num_steps=num_steps,
+                              batch_size=64, n_negatives=64,
+                              learning_rate=0.005, seed=seed)
+    buckets = bucket_sequences(seqs, max_len=max_len)
+    theta, _ = train_seqrec(buckets, n_items, seq_params)
+    U_seq = np.asarray(encode_users(theta, buckets, n_users, seq_params))
+    E_seq = np.asarray(theta["item_emb"])
+
+    def _single_stage_ndcg(U, E):
+        total = 0.0
+        for u in range(n_users):
+            scores = E @ U[u]
+            scores[seen[u]] = -np.inf
+            top = np.argpartition(-scores, k)[:k]
+            top = top[np.argsort(-scores[top], kind="stable")]
+            total += ndcg_at_k(top.tolist(), {next_item[u]}, k)
+        return total / n_users
+
+    ndcg_als = _single_stage_ndcg(X_als, Y_als)
+    ndcg_seq = _single_stage_ndcg(U_seq, E_seq)
+
+    # --- the fused path: the SERVED device store, not a host re-derivation
+    store = TwoStageTopK(X_als, Y_als, U_seq, E_seq, seen=seen,
+                         candidates=candidates)
+    try:
+        ids, _ = store.twos_topk(np.arange(n_users, dtype=np.int64), k)
+        ids = np.asarray(ids)
+    finally:
+        store.close()
+    ndcg_two = sum(
+        ndcg_at_k(ids[u].tolist(), {next_item[u]}, k)
+        for u in range(n_users)) / n_users
+
+    # stage-1 recall of the held-out item inside a HALVED budget — the
+    # quality headroom a tighter serving configuration would trade away
+    half = max(1, n_items // 2)
+    recall = 0
+    for u in range(n_users):
+        s1 = Y_als @ X_als[u]           # unmasked, matching stage 1
+        top_n = np.argpartition(-s1, half - 1)[:half]
+        recall += next_item[u] in set(top_n.tolist())
+
+    best_single = max(ndcg_als, ndcg_seq)
+    return {
+        "check": "twostage_vs_single_stage_quality_gate",
+        "ndcg_two_stage": round(ndcg_two, 4),
+        "ndcg_als_alone": round(ndcg_als, 4),
+        "ndcg_seqrec_alone": round(ndcg_seq, 4),
+        "gate_ndcg_not_worse": bool(ndcg_two >= best_single - 1e-9),
+        "als_recall_at_half_catalog": round(recall / n_users, 4),
+        "candidates": int(candidates),
+        "k": k, "n_users": n_users, "n_items": n_items,
+        "num_steps": num_steps,
+        "rank_retrieval": rank_retrieval, "rank_rerank": rank_rerank,
+        "protocol": ("per-user Markov walks (strides 1/3/7); held-out true "
+                     "next item; two-stage list served by "
+                     "TwoStageTopK.twos_topk"),
+    }
+
+
 if __name__ == "__main__":
     import json
 

@@ -18,6 +18,7 @@ from predictionio_tpu.controller.controllers import (
     PDataSource,
     PIdentityPreparator,
     PPreparator,
+    TwoStageServing,
 )
 from predictionio_tpu.controller.engine import (
     Engine,
@@ -102,6 +103,7 @@ __all__ = [
     "SimpleEngine",
     "StopAfterPrepareInterruption",
     "StopAfterReadInterruption",
+    "TwoStageServing",
     "WorkflowParams",
     "load_persistent_model",
     "params_from_dict",

@@ -14,10 +14,10 @@ Python values.
 from __future__ import annotations
 
 import abc
-from typing import Any, List, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.core.base import (
-    BaseDataSource, BasePreparator, BaseServing,
+    BaseDataSource, BasePreparator, BaseServing, Params,
 )
 from predictionio_tpu.core.context import ComputeContext
 
@@ -119,3 +119,65 @@ class LAverageServing(LServing):
     def serve(self, query: Any, predictions: Sequence[Any]) -> Any:
         ps: List[float] = [float(p) for p in predictions]
         return sum(ps) / len(ps)
+
+
+class TwoStageServing(LServing):
+    """Retrieval + re-rank combinator over ``EngineParams.algorithms =
+    [retrieval, reranker]`` (ROADMAP item 5 / ISSUE 20).
+
+    Two modes, one contract (the FIRST algorithm retrieves candidates,
+    the LAST re-scores them):
+
+    * **Fused (live deployments).** ``workflow.create_server.
+      build_deployment`` recognizes this serving, builds ONE
+      :class:`~predictionio_tpu.ops.twostage.TwoStageTopK` device
+      store over both models' tables, and calls :meth:`bind_fused`
+      with a route that serves whole queries through the fused
+      retrieval + re-rank device program — ``serve_query`` then
+      dispatches ONE device program per query batch and this class's
+      :meth:`serve` never runs.
+    * **Unbound (eval pipeline, host fallback).** :meth:`serve`
+      composes on host, reference-``Serving.scala`` style: the first
+      prediction's items are the candidate set, re-ordered by the last
+      prediction's scores (candidates the re-ranker did not score keep
+      their retrieval order, after every scored one).
+
+    Both prediction objects must carry ``item_scores`` (the
+    recommendation/seqrec templates' ``PredictedResult`` shape).
+    """
+
+    def __init__(self, params: Optional[Params] = None) -> None:
+        super().__init__(params)
+        self._fused = None
+
+    @property
+    def fused_bound(self) -> bool:
+        """Whether a fused device route is bound (live deployments)."""
+        return self._fused is not None
+
+    def bind_fused(self, route) -> None:
+        """Install the fused device route: a callable ``query ->
+        PredictedResult`` that dispatches the two-stage program."""
+        self._fused = route
+
+    def serve_fused(self, query: Any) -> Any:
+        """Serve one query through the bound fused device program."""
+        return self._fused(query)
+
+    def serve(self, query: Any, predictions: Sequence[Any]) -> Any:
+        import dataclasses
+
+        head = predictions[0]
+        if len(predictions) < 2:
+            return head
+        tail = predictions[-1]
+        rescores = {s.item: float(s.score)
+                    for s in getattr(tail, "item_scores", ())}
+        candidates = list(getattr(head, "item_scores", ()))
+        scored = [s for s in candidates if s.item in rescores]
+        unscored = [s for s in candidates if s.item not in rescores]
+        scored.sort(key=lambda s: -rescores[s.item])
+        reranked = tuple(
+            [dataclasses.replace(s, score=rescores[s.item])
+             for s in scored] + unscored)
+        return dataclasses.replace(head, item_scores=reranked)

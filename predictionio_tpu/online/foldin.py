@@ -120,10 +120,16 @@ class FoldInConsumer:
     """
 
     def __init__(self, model: Any, config: FoldInConfig,
-                 als_params: Optional[ALSParams] = None):
+                 als_params: Optional[ALSParams] = None,
+                 patch_lock: Optional[threading.Lock] = None):
         self._model = model
         self._cfg = config
         self._params = als_params
+        # serializes _patch's read-assign-append on user_map; a
+        # composite whose targets SHARE one vocabulary (the two-stage
+        # deployment) passes the same lock to every sharing consumer,
+        # else two tails folding the same new user race the append
+        self._patch_lock = patch_lock or threading.Lock()
         # model-provided solve hook (e.g. the sequentialrec template's
         # re-encode): when present it replaces the ALS half-step, and
         # ``foldin_time_ordered`` asks the gather to hand histories in
@@ -482,71 +488,114 @@ class FoldInConsumer:
         """Write the solved rows into the live store and publish the new
         users. Order is load-bearing: the store is patched (and grown)
         BEFORE new labels land in ``user_map``, so a racing predict
-        never resolves an index the store does not hold."""
+        never resolves an index the store does not hold. The whole
+        read-assign-append runs under ``patch_lock`` so two consumers
+        sharing one vocabulary assign each new user exactly one row."""
         model = self._model
         user_map = model.user_map
-        uidxs: List[int] = []
-        new_labels: List[str] = []
-        next_idx = len(user_map)
-        for uid in kept_ids:
-            idx = user_map.get(uid)
-            if idx is None:
-                idx = next_idx
-                next_idx += 1
-                new_labels.append(uid)
-            uidxs.append(int(idx))
-        seen_updates = {
-            uidx: np.unique(cols).astype(np.int64)
-            for uidx, cols in zip(uidxs, cols_list)}
-        server.patch_users(np.asarray(uidxs, dtype=np.int64), rows,
-                           seen_items=seen_updates)
-        seen = getattr(model, "seen", None)
-        if isinstance(seen, dict):
-            seen.update(seen_updates)
-        if new_labels:
-            user_map.append(new_labels)
+        with self._patch_lock:
+            uidxs: List[int] = []
+            new_labels: List[str] = []
+            next_idx = len(user_map)
+            for uid in kept_ids:
+                idx = user_map.get(uid)
+                if idx is None:
+                    idx = next_idx
+                    next_idx += 1
+                    new_labels.append(uid)
+                uidxs.append(int(idx))
+            seen_updates = {
+                uidx: np.unique(cols).astype(np.int64)
+                for uidx, cols in zip(uidxs, cols_list)}
+            server.patch_users(np.asarray(uidxs, dtype=np.int64), rows,
+                               seen_items=seen_updates)
+            seen = getattr(model, "seen", None)
+            if isinstance(seen, dict):
+                seen.update(seen_updates)
+            if new_labels:
+                user_map.append(new_labels)
         return len(kept_ids) - len(new_labels), len(new_labels)
+
+
+class CompositeFoldInConsumer:
+    """Fold-in for EVERY qualifying model of a multi-algorithm
+    deployment (ISSUE 20): each target keeps its own
+    :class:`FoldInConsumer` — its own cursor, its own solve lane, so
+    the ALS half-step and a seqrec re-encode coexist, each patching its
+    own (facet of the) device store — while this wrapper presents the
+    QueryServer's one-consumer surface (start/stop/stats/stale)."""
+
+    def __init__(self, consumers: List[FoldInConsumer]):
+        if not consumers:
+            raise ValueError(
+                "CompositeFoldInConsumer needs at least one consumer")
+        self._consumers = list(consumers)
+
+    @property
+    def consumers(self) -> List[FoldInConsumer]:
+        return list(self._consumers)
+
+    def start(self) -> "CompositeFoldInConsumer":
+        started: List[FoldInConsumer] = []
+        try:
+            for c in self._consumers:
+                c.start()
+                started.append(c)
+        except Exception:
+            # start() raises at deploy (not first fold) — a half-
+            # started composite must not leak tail threads
+            for c in started:
+                c.stop()
+            raise
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        for c in self._consumers:
+            c.stop(timeout=timeout)
+
+    @property
+    def stale(self) -> bool:
+        return any(c.stale for c in self._consumers)
+
+    def stats(self) -> Dict[str, Any]:
+        per = [c.stats() for c in self._consumers]
+        out = dict(per[0])
+        for other in per[1:]:
+            for key in ("folds", "foldErrors", "tailErrors",
+                        "usersPatched", "newUsers", "eventsFolded",
+                        "pendingEvents"):
+                out[key] += other[key]
+            out["stale"] = bool(out["stale"] or other["stale"])
+            stamps = [t for t in (out["lastFoldAt"],
+                                  other["lastFoldAt"]) if t]
+            out["lastFoldAt"] = max(stamps) if stamps else None
+        out["targets"] = per
+        return out
 
 
 def attach_foldin(deployment: Any,
                   interval: Optional[float] = None,
-                  count_threshold: Optional[int] = None) -> FoldInConsumer:
-    """Build a :class:`FoldInConsumer` for a loaded deployment
-    (``workflow.create_server.Deployment``): the first algorithm whose
-    model exposes the ALS device-serving surface is the fold-in target,
-    its ``ALSParams`` are the solve hyperparameters, and the
-    datasource params name the (app, channel, event names) to tail.
-    Raises when no deployed algorithm qualifies — ``--foldin on`` on an
+                  count_threshold: Optional[int] = None) -> Any:
+    """Build the fold-in consumer(s) for a loaded deployment
+    (``workflow.create_server.Deployment``): EVERY algorithm whose
+    model exposes the ALS device-serving surface is a fold-in target
+    (one algorithm on classic deployments; BOTH stages of a two-stage
+    deployment, whose facets route the writes to their half of the
+    fused store), its ``ALSParams`` or model-side ``fold_in_rows``
+    hook is the solve, and the datasource params name the (app,
+    channel, event names) to tail. Returns one
+    :class:`FoldInConsumer`, or a :class:`CompositeFoldInConsumer`
+    over several. Raises when no deployed algorithm qualifies, or when
+    a qualifying one has no usable solve — ``--foldin on`` on an
     incompatible engine must fail at deploy, not silently no-op."""
-    target = None
-    for i, model in enumerate(deployment.models):
-        if all(hasattr(model, a) for a in
-               ("user_map", "item_map", "device_server")):
-            target = (i, model)
-            break
-    if target is None:
+    targets = [(i, model) for i, model in enumerate(deployment.models)
+               if all(hasattr(model, a) for a in
+                      ("user_map", "item_map", "device_server"))]
+    if not targets:
         raise ValueError(
             "--foldin on: no deployed algorithm serves an ALS-style "
             "device model (user_map/item_map/device_server); online "
             "fold-in has nothing to patch")
-    i, model = target
-    _, aparams = deployment.engine_params.algorithm_params_list[i]
-    has_hook = callable(getattr(model, "fold_in_rows", None))
-    if not has_hook and not isinstance(aparams, ALSParams):
-        # refuse rather than guess: the fold-in solve is the training
-        # half-step, and hyperparameters inferred by getattr-with-
-        # defaults could silently solve a DIFFERENT objective than the
-        # one the deployed factors were trained under. A model that
-        # carries its OWN solve (fold_in_rows — e.g. the sequentialrec
-        # re-encode, whose hyperparameters travel inside the model)
-        # needs no ALSParams.
-        raise ValueError(
-            "--foldin on: the deployed algorithm's params "
-            f"({type(aparams).__name__}) are not ALSParams and the "
-            "model has no fold_in_rows hook, so the fold-in solve "
-            "cannot take its hyperparameters from training; give the "
-            "algorithm ALSParams (or a subclass), or a model-side "
-            "fold_in_rows encoder, to enable online fold-in")
     dsp = deployment.engine_params.data_source_params[1]
     app_name = getattr(dsp, "app_name", None)
     if not app_name:
@@ -565,9 +614,48 @@ def attach_foldin(deployment: Any,
     if count_threshold is not None:
         kwargs["count_threshold"] = int(count_threshold)
     config = FoldInConfig.from_env(**kwargs)
-    return FoldInConsumer(
-        model, config,
-        aparams if isinstance(aparams, ALSParams) else None)
+    consumers: List[FoldInConsumer] = []
+    # one patch lock per DISTINCT user_map object: two-stage targets
+    # share their vocabulary, and concurrent tails must not both
+    # append the same new user to it
+    locks: List[Tuple[Any, threading.Lock]] = []
+
+    def _lock_for(user_map: Any) -> threading.Lock:
+        for owner, lock in locks:
+            if owner is user_map:
+                return lock
+        lock = threading.Lock()
+        locks.append((user_map, lock))
+        return lock
+
+    for i, model in targets:
+        _, aparams = deployment.engine_params.algorithm_params_list[i]
+        has_hook = callable(getattr(model, "fold_in_rows", None))
+        if not has_hook and not isinstance(aparams, ALSParams):
+            # refuse rather than guess: the fold-in solve is the
+            # training half-step, and hyperparameters inferred by
+            # getattr-with-defaults could silently solve a DIFFERENT
+            # objective than the one the deployed factors were trained
+            # under. A model that carries its OWN solve (fold_in_rows
+            # — e.g. the sequentialrec re-encode, whose
+            # hyperparameters travel inside the model) needs no
+            # ALSParams.
+            raise ValueError(
+                "--foldin on: the deployed algorithm's params "
+                f"({type(aparams).__name__}) are not ALSParams and the "
+                "model has no fold_in_rows hook, so the fold-in solve "
+                "cannot take its hyperparameters from training; give "
+                "the algorithm ALSParams (or a subclass), or a "
+                "model-side fold_in_rows encoder, to enable online "
+                "fold-in")
+        consumers.append(FoldInConsumer(
+            model, config,
+            aparams if isinstance(aparams, ALSParams) else None,
+            patch_lock=_lock_for(model.user_map)))
+    if len(consumers) == 1:
+        return consumers[0]
+    return CompositeFoldInConsumer(consumers)
 
 
-__all__ = ["FoldInConfig", "FoldInConsumer", "attach_foldin"]
+__all__ = ["CompositeFoldInConsumer", "FoldInConfig", "FoldInConsumer",
+           "attach_foldin"]

@@ -655,6 +655,71 @@ class HostTopK:
 HOST_SERVE_MAX_ELEMS = 1 << 22
 
 
+# The serving-policy matrix (ISSUE 20 satellite): every feature that
+# forces the device backend — and therefore conflicts with an explicit
+# host backend — as TABLE ROWS instead of ad-hoc if-raises scattered
+# through choose_server. Each row is (name, predicate over the policy
+# flags, the message an explicit ``PIO_SERVING_BACKEND=host`` raises
+# when the row is active). Row order is the historical raise order.
+# New serving lanes (the two-stage store, the next one) land as rows.
+_SERVING_POLICY_ROWS: Tuple[Tuple[str, Callable[[Dict[str, Any]], bool],
+                                  str], ...] = (
+    ("resident",
+     lambda f: not f["host_capable"],
+     "PIO_SERVING_BACKEND=host but the factors are device-resident "
+     "jax Arrays"),
+    ("precision",
+     lambda f: f["explicit_precision"] in ("bf16", "int8"),
+     "PIO_SERVE_PRECISION={explicit_precision} conflicts with "
+     "PIO_SERVING_BACKEND=host: the quantized/bf16 store is a device "
+     "(HBM) policy; host serving is always fp32"),
+    ("foldin",
+     lambda f: f["foldin"],
+     "PIO_FOLDIN=on conflicts with PIO_SERVING_BACKEND=host: "
+     "online fold-in patches the DEVICE factor store in place "
+     "(DeviceTopK.patch_users); host serving has no updatable "
+     "store"),
+    ("sharded",
+     lambda f: f["sharded"],
+     "PIO_SERVE_SHARDS conflicts with PIO_SERVING_BACKEND="
+     "host: sharding the factor store over a mesh is a "
+     "device (HBM) policy; host serving has one store"),
+    ("two_stage",
+     lambda f: f["two_stage"],
+     "two-stage serving conflicts with PIO_SERVING_BACKEND=host: the "
+     "fused retrieval + re-rank top-k runs as ONE device program "
+     "(TwoStageTopK); host serving has no fused candidate lane"),
+)
+
+
+def validate_serving_policy(backend: str, *, host_capable: bool = True,
+                            explicit_precision: Optional[str] = None,
+                            foldin: bool = False, sharded: bool = False,
+                            two_stage: bool = False) -> str:
+    """Rule on one backend/feature combination against the serving
+    policy matrix (:data:`_SERVING_POLICY_ROWS`).
+
+    Returns the backend decision: ``"host"`` (explicitly requested and
+    nothing forbids it), ``"device"`` (explicitly requested, or some
+    active row forces it), or ``"auto"`` (nothing decided — the caller
+    applies its size heuristic). An explicit ``host`` backend raises
+    loudly on the FIRST active row, with the row's message. Unknown
+    backend strings fall through to ``auto`` — the historical
+    choose_server behavior."""
+    flags = {"host_capable": bool(host_capable),
+             "explicit_precision": explicit_precision,
+             "foldin": bool(foldin), "sharded": bool(sharded),
+             "two_stage": bool(two_stage)}
+    active = [row for row in _SERVING_POLICY_ROWS if row[1](flags)]
+    if backend == "host":
+        if active:
+            raise ValueError(active[0][2].format(**flags))
+        return "host"
+    if backend == "device" or active:
+        return "device"
+    return "auto"
+
+
 def choose_server(user_factors, item_factors,
                   seen: Optional[Dict[int, np.ndarray]] = None,
                   n_users: Optional[int] = None,
@@ -694,35 +759,15 @@ def choose_server(user_factors, item_factors,
     # only the operator's EXPLICIT bf16/int8 steers backend selection;
     # the accelerator default applies silently once a device store
     # exists
-    explicit = _serve_precision_explicit()
-    hbm_policy_serve = explicit in ("bf16", "int8")
-    foldin = foldin_enabled()
-    sharded = _serve_shards_env() > 1
     host_capable = not (hasattr(user_factors, "sharding")
                         or hasattr(item_factors, "sharding"))
-    if backend == "host":
-        if not host_capable:
-            raise ValueError(
-                "PIO_SERVING_BACKEND=host but the factors are "
-                "device-resident jax Arrays")
-        if hbm_policy_serve:
-            raise ValueError(
-                f"PIO_SERVE_PRECISION={explicit} conflicts with "
-                "PIO_SERVING_BACKEND=host: the quantized/bf16 store is "
-                "a device (HBM) policy; host serving is always fp32")
-        if foldin:
-            raise ValueError(
-                "PIO_FOLDIN=on conflicts with PIO_SERVING_BACKEND=host: "
-                "online fold-in patches the DEVICE factor store in place "
-                "(DeviceTopK.patch_users); host serving has no updatable "
-                "store")
-        if sharded:
-            raise ValueError(
-                "PIO_SERVE_SHARDS conflicts with PIO_SERVING_BACKEND="
-                "host: sharding the factor store over a mesh is a "
-                "device (HBM) policy; host serving has one store")
+    decision = validate_serving_policy(
+        backend, host_capable=host_capable,
+        explicit_precision=_serve_precision_explicit(),
+        foldin=foldin_enabled(), sharded=_serve_shards_env() > 1)
+    if decision == "host":
         cls = HostTopK
-    elif backend == "device" or hbm_policy_serve or foldin or sharded:
+    elif decision == "device":
         cls = DeviceTopK
     else:
         if host_capable:
@@ -2044,12 +2089,17 @@ class DeviceTopK:
                 return entry, lower_compile(
                     fn, *user_pre,
                     jax.ShapeDtypeStruct((bb,), jnp.int32))
-            _, kb, B, gg = entry
-            fn = self._items_program(kb, B, gg)
-            return entry, lower_compile(
-                fn, *items_pre,
-                jax.ShapeDtypeStruct((gg, B), jnp.int32),
-                jax.ShapeDtypeStruct((gg, B), jnp.float32))
+            if kind == "items":
+                _, kb, B, gg = entry
+                fn = self._items_program(kb, B, gg)
+                return entry, lower_compile(
+                    fn, *items_pre,
+                    jax.ShapeDtypeStruct((gg, B), jnp.int32),
+                    jax.ShapeDtypeStruct((gg, B), jnp.float32))
+            # subclass lanes (e.g. the two-stage ("two", ...) entries)
+            # lower through the overridable hook
+            return entry, self._aot_lower_entry(entry, user_pre,
+                                                items_pre)
 
         compiled = fallback = 0
         from concurrent.futures import ThreadPoolExecutor
@@ -2064,6 +2114,21 @@ class DeviceTopK:
                     compiled += 1
                     self._aot_programs.put((sig, entry), prog)
         return {"compiled": compiled, "fallback": fallback}
+
+    def _aot_lower_entry(self, entry: Tuple, user_pre: Tuple,
+                         items_pre: Tuple):
+        """AOT-lower one ladder entry of a kind this class does not
+        know — the subclass extension point through which new serving
+        lanes (the two-stage ``("two", ...)`` entries) join the SAME
+        precompile pool, cache and coverage accounting. None means "no
+        AOT" and the entry stays on its jit fallback, which
+        :meth:`warmup` then compiles via :meth:`_warm_entry`."""
+        return None
+
+    def _warm_entry(self, entry: Tuple) -> None:
+        """Execute one subclass-lane ladder entry so its jit fallback
+        compiles at warm-up, never on a live query. Base class: no
+        such lanes exist, nothing to warm."""
 
     def warmup(self, max_k: int = 128, batch_sizes: Tuple[int, ...] = ()) \
             -> Dict[str, int]:
@@ -2093,11 +2158,13 @@ class DeviceTopK:
             elif entry[0] == "users":
                 _, kb, bb = entry
                 self.users_topk(np.zeros(bb, dtype=np.int64), kb)
-            else:
+            elif entry[0] == "items":
                 _, kb, B, gg = entry
                 self._items_topk_batched(
                     np.zeros((gg, B), dtype=np.int32),
                     np.zeros((gg, B), dtype=np.float32), kb)
+            else:
+                self._warm_entry(entry)
         kmin = min(16, self.n_items)
         self.user_topk(0, kmin)
         self.users_topk(np.zeros(8, dtype=np.int64), kmin)

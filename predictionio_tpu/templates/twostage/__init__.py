@@ -1,0 +1,8 @@
+from predictionio_tpu.templates.twostage.engine import (  # noqa: F401
+    TwoStageALSAlgorithm,
+    TwoStagePrepared,
+    TwoStagePreparator,
+    TwoStagePreparatorParams,
+    TwoStageSeqRecAlgorithm,
+    engine_factory,
+)

@@ -187,6 +187,30 @@ BUILTIN_TEMPLATES: Dict[str, Dict] = {
             }],
         },
     },
+    "twostage": {
+        "description": "Two-stage serving: ALS retrieves N candidates, "
+                       "the seqrec encoder re-ranks them — fused into "
+                       "ONE device program per query batch (net-new; "
+                       "ROADMAP item 5)",
+        "engineFactory":
+            "predictionio_tpu.templates.twostage:engine_factory",
+        "variant": {
+            "id": "default",
+            "version": "default",
+            "engineFactory":
+                "predictionio_tpu.templates.twostage:engine_factory",
+            "datasource": {"params": {"appName": "INVALID_APP_NAME"}},
+            "preparator": {"params": {"maxSeqLen": 32}},
+            "algorithms": [{
+                "name": "als",
+                "params": {"rank": 32, "numIterations": 10, "seed": 3},
+            }, {
+                "name": "seqrec",
+                "params": {"rank": 32, "nLayers": 2, "nHeads": 2,
+                           "numSteps": 300, "seed": 7},
+            }],
+        },
+    },
     "textclassification": {
         "description": "Text -> label: hashed embedding table + LR "
                        "trained on device, NB over token counts "

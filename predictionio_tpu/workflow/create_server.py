@@ -337,8 +337,42 @@ def build_deployment(instance: EngineInstance, ctx: ComputeContext,
     sv_name, sv_params = engine_params.serving_params
     serving = engine._make(engine.serving_class_map, sv_name, sv_params,
                            "serving")
+    from predictionio_tpu.controller.controllers import TwoStageServing
+    if isinstance(serving, TwoStageServing):
+        _bind_two_stage(serving, algorithms, models)
     return Deployment(instance, engine, engine_params, algorithms,
                       models, serving)
+
+
+def _bind_two_stage(serving: Any, algorithms: List[Any],
+                    models: List[Any]) -> None:
+    """Fuse a ``TwoStageServing`` deployment onto ONE device store:
+    build a :class:`~predictionio_tpu.ops.twostage.TwoStageTopK` over
+    the retrieval model's factors AND the re-ranker's tables (loud
+    policy validation inside — host backend, mismatched maps, and
+    non-growable fold-in combos all refuse at load, never at query
+    time), point each model's device-server handle at its facet of the
+    store, and bind the serving's fused route so ``serve_query``
+    dispatches retrieval + re-rank as one device program per query
+    batch."""
+    from predictionio_tpu.ops.twostage import build_two_stage_store
+
+    if len(models) < 2:
+        raise ValueError(
+            "TwoStageServing needs EngineParams.algorithms = "
+            "[retrieval, reranker] (at least two algorithms); got "
+            f"{len(models)} — use LFirstServing for a single-algorithm "
+            "deployment")
+    retrieval, rerank = models[0], models[-1]
+    store = build_two_stage_store(retrieval, rerank)
+    retrieval._server = store.two_facet()
+    # re-rank scores are transformer logits — a user whose candidates
+    # all score negative still has a valid ranking, so the retrieval
+    # model's implicit-ALS positivity filter must not drop them
+    retrieval.serve_positive_scores_only = False
+    rerank._server = store.seq_facet()
+    algo0 = algorithms[0]
+    serving.bind_fused(lambda q: algo0.predict_base(retrieval, q))
 
 
 def warm_up(dep: Deployment,
@@ -392,6 +426,14 @@ def serve_query(dep: Deployment, query: Any) -> Any:
     that cost it (the reference could only say "the query was slow")."""
     with span("serve.supplement"):
         supplemented = dep.serving.supplement_base(query)
+    if getattr(dep.serving, "fused_bound", False):
+        # two-stage fused deployments serve the whole query through
+        # ONE device program (retrieval + re-rank never split): the
+        # per-algorithm predict loop would dispatch the stages
+        # separately and round-trip candidates through host
+        with span("serve.fused",
+                  attributes={"serving": type(dep.serving).__name__}):
+            return dep.serving.serve_fused(supplemented)
     predictions = []
     for algo, model in zip(dep.algorithms, dep.models):
         with span("serve.predict",

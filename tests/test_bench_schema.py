@@ -160,3 +160,45 @@ class TestScale1bLaneWiring:
         assert r["zero_compile_steady_state"] is True
         assert r["shard_balance"]["nShards"] == r["shards"]
         assert np.isfinite(r["ingest_events_per_sec"])
+
+
+class TestTwoStageLaneSchema:
+    def _lane(self):
+        lane = {"qps_ratio_two_vs_single": 1.3,
+                "zero_compile_both_lanes": True,
+                "single_dispatch_per_batch": True}
+        bench._stamp_device(lane)
+        return lane
+
+    def _artifact(self, lane):
+        return {"metric": bench.HEADLINE_METRIC, "value": 1,
+                "accelerator": False,
+                "detail": {"serving_twostage": lane}}
+
+    def test_complete_lane_conforms(self):
+        assert bench.artifact_schema_problems(
+            self._artifact(self._lane())) == []
+
+    @pytest.mark.parametrize("key", ["qps_ratio_two_vs_single",
+                                     "zero_compile_both_lanes",
+                                     "single_dispatch_per_batch"])
+    def test_missing_gate_key_is_caught(self, key):
+        lane = self._lane()
+        del lane[key]
+        problems = bench.artifact_schema_problems(self._artifact(lane))
+        assert any(key in p for p in problems), problems
+
+    def test_twostage_lane_wiring_end_to_end(self):
+        """The CPU-sized twostage_serving shape runs end to end: zero
+        compiles in the steady state on BOTH lanes, exactly one device
+        dispatch per query batch, and a schema-clean artifact (the
+        wiring `main` runs in --smoke)."""
+        r = bench.twostage_serving_bench(n_users=64, n_items=128,
+                                         rank_rerank=16, candidates=16,
+                                         duration_sec=0.3, clients=2)
+        assert r["device"]
+        assert r["zero_compile_both_lanes"] is True
+        assert r["single_dispatch_per_batch"] is True
+        assert np.isfinite(r["qps_ratio_two_vs_single"])
+        assert np.isfinite(r["work_ratio_full_vs_twostage"])
+        assert bench.artifact_schema_problems(self._artifact(r)) == []
